@@ -1,0 +1,415 @@
+"""Parameter surface: defaults, aliases, type coercion, conflict checks.
+
+Mirrors the reference's single string-map config pipeline used identically by
+CLI, config file, and Python params dict (reference: include/LightGBM/config.h
+ConfigBase::Set + ParameterAlias::KeyAliasTransform config.h:322-416, conflict
+derivation src/io/config.cpp:138-176).  The TPU build keeps the same parameter
+names, aliases, and defaults so reference conf files run unmodified.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Mapping, Optional
+
+# ---------------------------------------------------------------------------
+# Alias table (reference config.h:322-416).  alias -> canonical name.
+# ---------------------------------------------------------------------------
+PARAM_ALIASES: Dict[str, str] = {
+    "config": "config_file",
+    "nthread": "num_threads",
+    "num_thread": "num_threads",
+    "random_seed": "seed",
+    "boosting": "boosting_type",
+    "boost": "boosting_type",
+    "application": "objective",
+    "app": "objective",
+    "train_data": "data",
+    "train": "data",
+    "model_output": "output_model",
+    "model_out": "output_model",
+    "model_input": "input_model",
+    "model_in": "input_model",
+    "predict_result": "output_result",
+    "prediction_result": "output_result",
+    "valid": "valid_data",
+    "test_data": "valid_data",
+    "test": "valid_data",
+    "is_sparse": "is_enable_sparse",
+    "enable_sparse": "is_enable_sparse",
+    "pre_partition": "is_pre_partition",
+    "tranining_metric": "is_training_metric",
+    "train_metric": "is_training_metric",
+    "ndcg_at": "ndcg_eval_at",
+    "eval_at": "ndcg_eval_at",
+    "min_data_per_leaf": "min_data_in_leaf",
+    "min_data": "min_data_in_leaf",
+    "min_child_samples": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf",
+    "min_hessian": "min_sum_hessian_in_leaf",
+    "min_child_weight": "min_sum_hessian_in_leaf",
+    "num_leaf": "num_leaves",
+    "sub_feature": "feature_fraction",
+    "colsample_bytree": "feature_fraction",
+    "num_iteration": "num_iterations",
+    "num_tree": "num_iterations",
+    "num_round": "num_iterations",
+    "num_trees": "num_iterations",
+    "num_rounds": "num_iterations",
+    "sub_row": "bagging_fraction",
+    "subsample": "bagging_fraction",
+    "subsample_freq": "bagging_freq",
+    "shrinkage_rate": "learning_rate",
+    "tree": "tree_learner",
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port",
+    "two_round_loading": "use_two_round_loading",
+    "two_round": "use_two_round_loading",
+    "mlist": "machine_list_file",
+    "is_save_binary": "is_save_binary_file",
+    "save_binary": "is_save_binary_file",
+    "early_stopping_rounds": "early_stopping_round",
+    "early_stopping": "early_stopping_round",
+    "verbosity": "verbose",
+    "header": "has_header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column",
+    "query": "group_column",
+    "query_column": "group_column",
+    "ignore_feature": "ignore_column",
+    "blacklist": "ignore_column",
+    "categorical_feature": "categorical_column",
+    "cat_column": "categorical_column",
+    "cat_feature": "categorical_column",
+    "predict_raw_score": "is_predict_raw_score",
+    "predict_leaf_index": "is_predict_leaf_index",
+    "raw_score": "is_predict_raw_score",
+    "leaf_index": "is_predict_leaf_index",
+    "min_split_gain": "min_gain_to_split",
+    "topk": "top_k",
+    "reg_alpha": "lambda_l1",
+    "reg_lambda": "lambda_l2",
+    "num_classes": "num_class",
+    "unbalanced_sets": "is_unbalance",
+}
+
+# ---------------------------------------------------------------------------
+# Defaults (reference config.h:86-264).
+# ---------------------------------------------------------------------------
+_DEFAULTS: Dict[str, Any] = {
+    # task / top-level
+    "task": "train",
+    "objective": "regression",
+    "boosting_type": "gbdt",
+    "tree_learner": "serial",
+    "seed": 0,
+    "num_threads": 0,
+    "metric": [],
+    # IO
+    "max_bin": 255,
+    "num_class": 1,
+    "data_random_seed": 1,
+    "data": "",
+    "valid_data": [],
+    "output_model": "LightGBM_model.txt",
+    "output_result": "LightGBM_predict_result.txt",
+    "input_model": "",
+    "verbose": 1,
+    "num_iteration_predict": -1,
+    "is_pre_partition": False,
+    "is_enable_sparse": True,
+    "use_two_round_loading": False,
+    "is_save_binary_file": False,
+    "enable_load_from_binary_file": True,
+    "bin_construct_sample_cnt": 200000,
+    "is_predict_leaf_index": False,
+    "is_predict_raw_score": False,
+    "min_data_in_bin": 5,
+    "max_conflict_rate": 0.0,
+    "enable_bundle": True,
+    "has_header": False,
+    "label_column": "",
+    "weight_column": "",
+    "group_column": "",
+    "ignore_column": "",
+    "categorical_column": "",
+    # objective
+    "sigmoid": 1.0,
+    "huber_delta": 1.0,
+    "fair_c": 1.0,
+    "gaussian_eta": 1.0,
+    "poisson_max_delta_step": 0.7,
+    "label_gain": [],
+    "max_position": 20,
+    "is_unbalance": False,
+    "scale_pos_weight": 1.0,
+    # metric
+    "ndcg_eval_at": [1, 2, 3, 4, 5],
+    # tree
+    "min_data_in_leaf": 100,
+    "min_sum_hessian_in_leaf": 10.0,
+    "lambda_l1": 0.0,
+    "lambda_l2": 0.0,
+    "min_gain_to_split": 0.0,
+    "num_leaves": 127,
+    "feature_fraction_seed": 2,
+    "feature_fraction": 1.0,
+    "histogram_pool_size": -1.0,
+    "max_depth": -1,
+    "top_k": 20,
+    # boosting
+    "output_freq": 1,
+    "is_training_metric": False,
+    "num_iterations": 10,
+    "learning_rate": 0.1,
+    "bagging_fraction": 1.0,
+    "bagging_seed": 3,
+    "bagging_freq": 0,
+    "early_stopping_round": 0,
+    "drop_rate": 0.1,
+    "max_drop": 50,
+    "skip_drop": 0.5,
+    "xgboost_dart_mode": False,
+    "uniform_drop": False,
+    "drop_seed": 4,
+    "top_rate": 0.2,
+    "other_rate": 0.1,
+    # network (TPU build: devices on the mesh replace machines)
+    "num_machines": 1,
+    "local_listen_port": 12400,
+    "time_out": 120,
+    "machine_list_file": "",
+    # TPU-specific extensions (no reference equivalent)
+    "tpu_histogram_impl": "auto",  # auto | scatter | onehot | pallas
+    "tpu_double_hist": False,      # float64 histogram accumulation (CPU tests)
+}
+
+_BOOL_KEYS = {k for k, v in _DEFAULTS.items() if isinstance(v, bool)}
+_INT_KEYS = {k for k, v in _DEFAULTS.items() if isinstance(v, int) and not isinstance(v, bool)}
+_FLOAT_KEYS = {k for k, v in _DEFAULTS.items() if isinstance(v, float)}
+_LIST_KEYS = {"metric", "valid_data", "label_gain", "ndcg_eval_at"}
+
+_OBJECTIVE_ALIASES = {
+    "regression": "regression",
+    "regression_l2": "regression",
+    "mean_squared_error": "regression",
+    "mse": "regression",
+    "l2": "regression",
+    "regression_l1": "regression_l1",
+    "mean_absolute_error": "regression_l1",
+    "mae": "regression_l1",
+    "l1": "regression_l1",
+    "huber": "huber",
+    "fair": "fair",
+    "poisson": "poisson",
+    "binary": "binary",
+    "multiclass": "multiclass",
+    "softmax": "multiclass",
+    "lambdarank": "lambdarank",
+    "rank": "lambdarank",
+}
+
+_METRIC_ALIASES = {
+    "l2": "l2", "mse": "l2", "mean_squared_error": "l2", "regression": "l2",
+    "l1": "l1", "mae": "l1", "mean_absolute_error": "l1",
+    "huber": "huber",
+    "fair": "fair",
+    "poisson": "poisson",
+    "binary_logloss": "binary_logloss", "binary": "binary_logloss",
+    "binary_error": "binary_error",
+    "auc": "auc",
+    "multi_logloss": "multi_logloss", "multiclass": "multi_logloss",
+    "multi_error": "multi_error",
+    "ndcg": "ndcg",
+    "map": "map", "mean_average_precision": "map",
+}
+
+
+def apply_aliases(params: Mapping[str, Any]) -> Dict[str, Any]:
+    """KeyAliasTransform: canonical keys win over aliases (config.h:405-415)."""
+    out: Dict[str, Any] = {}
+    aliased: Dict[str, Any] = {}
+    for key, value in params.items():
+        key = key.strip()
+        if key in PARAM_ALIASES:
+            aliased[PARAM_ALIASES[key]] = value
+        else:
+            out[key] = value
+    for key, value in aliased.items():
+        out.setdefault(key, value)
+    return out
+
+
+def _coerce_bool(value: Any) -> bool:
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, (int, float)):
+        return bool(value)
+    return str(value).strip().lower() in ("true", "1", "yes", "y", "t", "+")
+
+
+def _coerce_list(value: Any, elem=str) -> List[Any]:
+    if isinstance(value, (list, tuple)):
+        return [elem(v) for v in value]
+    s = str(value).strip()
+    if not s:
+        return []
+    return [elem(v) for v in s.replace(",", " ").split()]
+
+
+class Config:
+    """Typed view over a raw params dict, after alias resolution.
+
+    Attribute access returns the canonical typed value, e.g. ``cfg.num_leaves``.
+    Unknown parameters are kept in ``raw`` (the reference silently ignores
+    unknown keys too).
+    """
+
+    def __init__(self, params: Optional[Mapping[str, Any]] = None):
+        params = dict(params or {})
+        params = apply_aliases(params)
+        self.raw: Dict[str, Any] = params
+        self._values: Dict[str, Any] = copy.deepcopy(_DEFAULTS)
+        for key, value in params.items():
+            if key not in self._values:
+                continue
+            self._values[key] = self._coerce(key, value)
+        self._check_param_conflict()
+
+    @staticmethod
+    def _coerce(key: str, value: Any) -> Any:
+        if key in _LIST_KEYS:
+            if key == "metric":
+                names = _coerce_list(value, str)
+                out = []
+                for name in names:
+                    if name in ("", "none", "null", "na"):
+                        continue
+                    out.append(_METRIC_ALIASES.get(name, name))
+                return out
+            if key in ("label_gain",):
+                return _coerce_list(value, float)
+            if key in ("ndcg_eval_at",):
+                return _coerce_list(value, int)
+            return _coerce_list(value, str)
+        if key in _BOOL_KEYS:
+            return _coerce_bool(value)
+        if key in _INT_KEYS:
+            return int(float(value))
+        if key in _FLOAT_KEYS:
+            return float(value)
+        if key == "objective":
+            name = str(value).strip()
+            return _OBJECTIVE_ALIASES.get(name, name)
+        return str(value).strip() if isinstance(value, str) else value
+
+    def _check_param_conflict(self) -> None:
+        """Reference CheckParamConflict (config.cpp:138-176) semantics."""
+        v = self._values
+        if v["tree_learner"] not in ("serial", "feature", "data", "voting"):
+            raise ValueError(f"Unknown tree learner type {v['tree_learner']}")
+        # num_machines here means mesh devices; 1 device => normalize back to
+        # serial like the reference (config.cpp:161-172).
+        if v["num_machines"] <= 1:
+            v["is_parallel"] = False
+            v["tree_learner"] = "serial"
+        else:
+            v["is_parallel"] = v["tree_learner"] != "serial"
+            if not v["is_parallel"]:
+                v["num_machines"] = 1
+        v["is_parallel_find_bin"] = v["is_parallel"] and v["tree_learner"] in ("data", "voting")
+        obj = v["objective"]
+        if obj == "multiclass":
+            # Reference: "greater than 2 for multiclass training"
+            # (config.cpp:143-146).
+            if v["num_class"] <= 2:
+                raise ValueError(
+                    "Number of classes should be specified and greater than 2 "
+                    "for multiclass training")
+        else:
+            if v["num_class"] != 1 and v["task"] == "train":
+                raise ValueError("Number of classes must be 1 for non-multiclass training")
+        # Objective/metric compatibility (config.cpp:152-160).
+        for metric in v["metric"]:
+            metric_multiclass = metric in ("multi_logloss", "multi_error")
+            if (obj == "multiclass") != metric_multiclass:
+                raise ValueError("Objective and metrics don't match")
+        if v["boosting_type"] == "goss" and (
+            v["bagging_fraction"] < 1.0 and v["bagging_freq"] > 0
+        ):
+            raise ValueError("cannot use bagging in GOSS")
+        if not v["metric"]:
+            v["metric"] = default_metric_for_objective(obj)
+        if v["num_leaves"] <= 1:
+            raise ValueError("num_leaves must be > 1")
+        if v["max_depth"] > 0:
+            v["num_leaves"] = min(v["num_leaves"], 2 ** v["max_depth"])
+
+    def __getattr__(self, name: str) -> Any:
+        values = object.__getattribute__(self, "_values")
+        if name in values:
+            return values[name]
+        raise AttributeError(name)
+
+    def __getitem__(self, name: str) -> Any:
+        return self._values[name]
+
+    def get(self, name: str, default: Any = None) -> Any:
+        return self._values.get(name, default)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+    def updated(self, **kwargs: Any) -> "Config":
+        merged = dict(self.raw)
+        merged.update(kwargs)
+        return Config(merged)
+
+
+def default_metric_for_objective(objective: str) -> List[str]:
+    """GetMetricType default: metric matching the objective (config.cpp)."""
+    table = {
+        "regression": ["l2"],
+        "regression_l1": ["l1"],
+        "huber": ["huber"],
+        "fair": ["fair"],
+        "poisson": ["poisson"],
+        "binary": ["binary_logloss"],
+        "multiclass": ["multi_logloss"],
+        "lambdarank": ["ndcg"],
+    }
+    return list(table.get(objective, []))
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """Parse a reference-style ``key = value`` conf file with # comments
+    (reference application.cpp:46-104)."""
+    params: Dict[str, str] = {}
+    with open(path, "r") as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            key, value = line.split("=", 1)
+            params[key.strip()] = value.strip()
+    return params
+
+
+def parse_cli_args(argv: List[str]) -> Dict[str, str]:
+    """Parse ``k=v`` CLI tokens; a config file (if given) is loaded first and
+    command-line keys override it (reference application.cpp:46-76)."""
+    params: Dict[str, str] = {}
+    for token in argv:
+        if "=" not in token:
+            continue
+        key, value = token.split("=", 1)
+        params[key.strip()] = value.strip()
+    params = apply_aliases(params)
+    config_path = params.pop("config_file", None)
+    if config_path:
+        file_params = apply_aliases(parse_config_file(config_path))
+        file_params.update(params)
+        params = file_params
+    return params

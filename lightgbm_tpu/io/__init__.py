@@ -1,0 +1,3 @@
+from .binning import BinMapper, CATEGORICAL, NUMERICAL  # noqa: F401
+from .dataset import BinnedDataset, Metadata  # noqa: F401
+from .parser import detect_format, parse_file  # noqa: F401

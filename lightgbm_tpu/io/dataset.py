@@ -1,0 +1,356 @@
+"""Binned dataset + metadata: the training-side data representation.
+
+Reference: include/LightGBM/dataset.h + src/io/dataset.cpp, dataset_loader.cpp.
+TPU-first design decisions (SURVEY.md §7 step 2):
+  * storage is dense, feature-major ``bins[F_used, N]`` uint8/uint16 — no
+    sparse/4-bit variants (TPU wants dense contiguous lanes; sparse features
+    simply bin densely),
+  * histograms are built from the full bin codes, so there is no default-bin
+    FixHistogram reconstruction step (dataset.cpp:451-471 becomes a no-op),
+  * one feature per group (the reference's Construct also always uses NoGroup
+    at this pin, dataset.cpp:36-61).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import log
+from .binning import BinMapper, CATEGORICAL, NUMERICAL
+
+_BINARY_TOKEN = b"__lightgbm_tpu_dataset_v1__"
+
+
+class Metadata:
+    """Labels, weights, query boundaries, init scores (dataset.h:35-247)."""
+
+    def __init__(self, num_data: int = 0):
+        self.num_data = num_data
+        self.label: Optional[np.ndarray] = None
+        self.weights: Optional[np.ndarray] = None
+        self.query_boundaries: Optional[np.ndarray] = None
+        self.query_weights: Optional[np.ndarray] = None
+        self.init_score: Optional[np.ndarray] = None
+
+    def set_label(self, label) -> None:
+        self.label = np.asarray(label, dtype=np.float32).ravel()
+
+    def set_weights(self, weights) -> None:
+        if weights is None:
+            self.weights = None
+            return
+        self.weights = np.asarray(weights, dtype=np.float32).ravel()
+        self._update_query_weights()
+
+    def set_init_score(self, init_score) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        self.init_score = np.asarray(init_score, dtype=np.float64).ravel()
+
+    def set_query(self, group) -> None:
+        """``group`` is per-query sizes (python API) -> cumulative boundaries."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        group = np.asarray(group, dtype=np.int64).ravel()
+        self.query_boundaries = np.concatenate([[0], np.cumsum(group)]).astype(np.int64)
+        self._update_query_weights()
+
+    def set_query_id(self, qid) -> None:
+        """Per-row query ids (file .query format variant)."""
+        qid = np.asarray(qid).ravel()
+        change = np.nonzero(np.diff(qid))[0] + 1
+        bounds = np.concatenate([[0], change, [len(qid)]])
+        self.query_boundaries = bounds.astype(np.int64)
+        self._update_query_weights()
+
+    def _update_query_weights(self) -> None:
+        # Sum of row weights per query (metadata.cpp query weight init).
+        if self.query_boundaries is None or self.weights is None:
+            self.query_weights = None
+            return
+        num_queries = len(self.query_boundaries) - 1
+        qw = np.zeros(num_queries, dtype=np.float32)
+        for i in range(num_queries):
+            a, b = self.query_boundaries[i], self.query_boundaries[i + 1]
+            qw[i] = self.weights[a:b].sum() / max(1, b - a)
+        self.query_weights = qw
+
+    def load_side_files(self, data_path: str) -> None:
+        """Companion ``.weight`` / ``.query`` / ``.init`` files
+        (metadata.cpp file side-loading)."""
+        wpath = data_path + ".weight"
+        if os.path.exists(wpath):
+            self.set_weights(np.loadtxt(wpath, dtype=np.float64).ravel())
+            log.info("Loading weights from %s", wpath)
+        qpath = data_path + ".query"
+        if os.path.exists(qpath):
+            sizes = np.loadtxt(qpath, dtype=np.int64).ravel()
+            self.query_boundaries = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+            self._update_query_weights()
+            log.info("Loading query boundaries from %s", qpath)
+        ipath = data_path + ".init"
+        if os.path.exists(ipath):
+            self.set_init_score(np.loadtxt(ipath, dtype=np.float64).ravel())
+
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+
+class BinnedDataset:
+    """Column-binned training matrix.
+
+    Attributes:
+      bins: [num_used_features, num_data] uint8/uint16 feature-major bin codes.
+      mappers: per *used* feature BinMapper.
+      used_feature_map: used feature -> real (original) feature index.
+      real_to_inner: real feature index -> used index or -1 (trivial/ignored).
+      num_total_features: F of the raw matrix.
+      feature_names: real-feature names.
+      metadata: Metadata.
+    """
+
+    def __init__(self) -> None:
+        self.bins: np.ndarray = np.zeros((0, 0), dtype=np.uint8)
+        self.mappers: List[BinMapper] = []
+        self.used_feature_map: List[int] = []
+        self.real_to_inner: np.ndarray = np.zeros(0, dtype=np.int64)
+        self.num_total_features = 0
+        self.feature_names: List[str] = []
+        self.metadata = Metadata()
+        self.max_bin = 255
+        self.label_idx = 0
+
+    # -- construction ---------------------------------------------------
+    @classmethod
+    def from_matrix(cls, data: np.ndarray, label=None, *,
+                    max_bin: int = 255, min_data_in_bin: int = 5,
+                    min_data_in_leaf: int = 100,
+                    bin_construct_sample_cnt: int = 200000,
+                    categorical_features: Sequence[int] = (),
+                    ignore_features: Sequence[int] = (),
+                    feature_names: Optional[Sequence[str]] = None,
+                    data_random_seed: int = 1,
+                    label_idx: int = 0,
+                    predefined_mappers: Optional[List[Optional[BinMapper]]] = None,
+                    ) -> "BinnedDataset":
+        """Bin a raw [N, F] float matrix (dataset_loader.cpp:656-820 flow:
+        sample rows -> per-feature FindBin -> extract features)."""
+        data = np.ascontiguousarray(np.asarray(data, dtype=np.float64))
+        if data.ndim != 2:
+            raise ValueError("data must be 2-D [num_data, num_features]")
+        num_data, num_features = data.shape
+        self = cls()
+        self.num_total_features = num_features
+        self.max_bin = max_bin
+        self.label_idx = label_idx
+        cat = set(int(c) for c in categorical_features)
+        ignored = set(int(c) for c in ignore_features)
+        if feature_names is None:
+            self.feature_names = [f"Column_{i}" for i in range(num_features)]
+        else:
+            self.feature_names = list(feature_names)
+
+        # Row sampling for bin construction (config bin_construct_sample_cnt,
+        # dataset_loader.cpp sample_cnt default 200k).
+        rng = np.random.RandomState(data_random_seed)
+        if num_data > bin_construct_sample_cnt:
+            sample_idx = np.sort(rng.choice(num_data, bin_construct_sample_cnt,
+                                            replace=False))
+            sample = data[sample_idx]
+        else:
+            sample = data
+        total_sample_cnt = sample.shape[0]
+
+        # Trivial-feature filter count is scaled to the sample
+        # (dataset_loader.cpp:490,704): 0.95 * min_data_in_leaf / num_data
+        # * sample_cnt.
+        filter_cnt = int(0.95 * min_data_in_leaf / max(1, num_data) * total_sample_cnt)
+
+        self.real_to_inner = np.full(num_features, -1, dtype=np.int64)
+        mappers: List[BinMapper] = []
+        used: List[int] = []
+        for f in range(num_features):
+            if f in ignored:
+                continue
+            if predefined_mappers is not None and predefined_mappers[f] is not None:
+                mapper = predefined_mappers[f]
+            else:
+                col = sample[:, f]
+                nonzero = col[col != 0.0]
+                mapper = BinMapper().find_bin(
+                    nonzero, total_sample_cnt, max_bin, min_data_in_bin,
+                    filter_cnt,
+                    CATEGORICAL if f in cat else NUMERICAL)
+            if mapper.is_trivial:
+                continue
+            self.real_to_inner[f] = len(used)
+            used.append(f)
+            mappers.append(mapper)
+        self.used_feature_map = used
+        self.mappers = mappers
+        if not used:
+            log.warning("All features are trivial; dataset has no usable feature")
+
+        dtype = np.uint8 if max(
+            [m.num_bin for m in mappers] or [1]) <= 256 else np.uint16
+        self.bins = np.zeros((len(used), num_data), dtype=dtype)
+        for inner, f in enumerate(used):
+            self.bins[inner] = mappers[inner].value_to_bin(data[:, f]).astype(dtype)
+
+        self.metadata = Metadata(num_data)
+        if label is not None:
+            self.metadata.set_label(label)
+        else:
+            self.metadata.set_label(np.zeros(num_data, dtype=np.float32))
+        return self
+
+    def create_valid(self, data: np.ndarray, label=None) -> "BinnedDataset":
+        """Bin a validation matrix with *this* dataset's mappers
+        (CreateValid/CopyFeatureMapperFrom, dataset.cpp:124-208)."""
+        data = np.asarray(data, dtype=np.float64)
+        valid = BinnedDataset()
+        valid.num_total_features = self.num_total_features
+        valid.max_bin = self.max_bin
+        valid.feature_names = list(self.feature_names)
+        valid.used_feature_map = list(self.used_feature_map)
+        valid.real_to_inner = self.real_to_inner.copy()
+        valid.mappers = self.mappers
+        num_data = data.shape[0]
+        valid.bins = np.zeros((len(self.used_feature_map), num_data),
+                              dtype=self.bins.dtype)
+        for inner, f in enumerate(self.used_feature_map):
+            valid.bins[inner] = self.mappers[inner].value_to_bin(
+                data[:, f]).astype(self.bins.dtype)
+        valid.metadata = Metadata(num_data)
+        if label is not None:
+            valid.metadata.set_label(label)
+        else:
+            valid.metadata.set_label(np.zeros(num_data, dtype=np.float32))
+        return valid
+
+    def subset(self, indices: np.ndarray) -> "BinnedDataset":
+        """Row subset sharing mappers (CopySubset, dataset.cpp:210-230)."""
+        indices = np.asarray(indices, dtype=np.int64)
+        sub = BinnedDataset()
+        sub.num_total_features = self.num_total_features
+        sub.max_bin = self.max_bin
+        sub.feature_names = list(self.feature_names)
+        sub.used_feature_map = list(self.used_feature_map)
+        sub.real_to_inner = self.real_to_inner.copy()
+        sub.mappers = self.mappers
+        sub.bins = np.ascontiguousarray(self.bins[:, indices])
+        sub.metadata = Metadata(len(indices))
+        md, smd = self.metadata, sub.metadata
+        if md.label is not None:
+            smd.set_label(md.label[indices])
+        if md.weights is not None:
+            smd.set_weights(md.weights[indices])
+        if md.init_score is not None and md.num_data:
+            # init_score may be class-major [num_class * num_data].
+            per_class = md.init_score.reshape(-1, md.num_data)
+            smd.set_init_score(per_class[:, indices].ravel())
+        if md.query_boundaries is not None:
+            # Reconstruct per-query boundaries for the subset; rows of one
+            # query must stay contiguous (metadata.cpp CheckOrPartition
+            # Log::Fatal on misalignment).
+            qid = np.searchsorted(md.query_boundaries, indices, side="right") - 1
+            if np.any(np.diff(qid) < 0):
+                log.fatal("Data partition in subset is not aligned with query boundaries")
+            change = np.nonzero(np.diff(qid))[0] + 1
+            bounds = np.concatenate([[0], change, [len(indices)]])
+            smd.query_boundaries = bounds.astype(np.int64)
+            smd._update_query_weights()
+        return sub
+
+    # -- accessors -------------------------------------------------------
+    @property
+    def num_data(self) -> int:
+        return self.bins.shape[1]
+
+    @property
+    def num_features(self) -> int:
+        """Number of *used* (non-trivial) features."""
+        return self.bins.shape[0]
+
+    def num_bin_per_feature(self) -> np.ndarray:
+        return np.asarray([m.num_bin for m in self.mappers], dtype=np.int32)
+
+    def is_categorical_per_feature(self) -> np.ndarray:
+        return np.asarray([m.bin_type == CATEGORICAL for m in self.mappers],
+                          dtype=bool)
+
+    def feature_infos(self) -> List[str]:
+        """Per real feature info strings for the model file."""
+        infos = []
+        for f in range(self.num_total_features):
+            inner = self.real_to_inner[f]
+            infos.append("none" if inner < 0 else self.mappers[inner].feature_info())
+        return infos
+
+    # -- binary cache ----------------------------------------------------
+    def save_binary(self, path: str) -> None:
+        """Binary dataset cache (dataset.cpp:306-389 equivalent).
+
+        Format: token header + npz archive of raw arrays, with non-array
+        metadata as a JSON blob.  Deliberately pickle-free so loading an
+        untrusted cache cannot execute code."""
+        meta_json = json.dumps({
+            "mappers": [m.to_state() for m in self.mappers],
+            "used_feature_map": self.used_feature_map,
+            "num_total_features": self.num_total_features,
+            "feature_names": self.feature_names,
+            "max_bin": self.max_bin,
+        })
+        arrays: Dict[str, Any] = {
+            "bins": self.bins,
+            "real_to_inner": self.real_to_inner,
+            "meta_json": np.frombuffer(meta_json.encode(), dtype=np.uint8),
+        }
+        for key in ("label", "weights", "query_boundaries", "init_score"):
+            value = getattr(self.metadata, key)
+            if value is not None:
+                arrays[key] = value
+        with open(path, "wb") as fh:
+            fh.write(_BINARY_TOKEN)
+            np.savez_compressed(fh, **arrays)
+        log.info("Saved binary dataset to %s", path)
+
+    @classmethod
+    def is_binary_file(cls, path: str) -> bool:
+        try:
+            with open(path, "rb") as fh:
+                return fh.read(len(_BINARY_TOKEN)) == _BINARY_TOKEN
+        except OSError:
+            return False
+
+    @classmethod
+    def load_binary(cls, path: str) -> "BinnedDataset":
+        with open(path, "rb") as fh:
+            token = fh.read(len(_BINARY_TOKEN))
+            if token != _BINARY_TOKEN:
+                raise ValueError(f"{path} is not a lightgbm_tpu binary dataset")
+            with np.load(fh, allow_pickle=False) as npz:
+                arrays = {k: npz[k] for k in npz.files}
+        meta = json.loads(bytes(arrays["meta_json"]).decode())
+        self = cls()
+        self.bins = arrays["bins"]
+        self.mappers = [BinMapper.from_state(s) for s in meta["mappers"]]
+        self.used_feature_map = list(meta["used_feature_map"])
+        self.real_to_inner = np.asarray(arrays["real_to_inner"])
+        self.num_total_features = int(meta["num_total_features"])
+        self.feature_names = list(meta["feature_names"])
+        self.max_bin = int(meta["max_bin"])
+        self.metadata = Metadata(self.bins.shape[1])
+        if "label" in arrays:
+            self.metadata.label = arrays["label"]
+        self.metadata.weights = arrays.get("weights")
+        self.metadata.query_boundaries = arrays.get("query_boundaries")
+        self.metadata.init_score = arrays.get("init_score")
+        self.metadata._update_query_weights()
+        return self

@@ -1,0 +1,104 @@
+"""Text parsers: CSV / TSV / LibSVM with format auto-detection.
+
+Behavioral model: reference src/io/parser.{cpp,hpp} — the format is guessed
+from delimiter statistics of the first lines (parser.cpp:10-72), the label
+column defaults to column 0, and rows are produced as sparse (col, value)
+pairs.  This implementation is vectorized NumPy rather than a line-by-line
+state machine.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def detect_format(lines: List[str]) -> str:
+    """Return one of 'csv', 'tsv', 'libsvm' (parser.cpp:10-72)."""
+    num_comma = 0
+    num_tab = 0
+    num_colon = 0
+    for line in lines:
+        num_comma += line.count(",")
+        num_tab += line.count("\t")
+        num_colon += line.count(":")
+    if num_colon > 0 and num_colon >= max(num_comma, num_tab):
+        return "libsvm"
+    if num_tab >= num_comma:
+        return "tsv" if num_tab > 0 else "csv"
+    return "csv"
+
+
+def _parse_delimited(lines: List[str], delim: str, label_idx: int
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    rows = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        parts = line.split(delim)
+        rows.append([float(p) if p not in ("", "na", "nan", "NA", "NaN", "null") else 0.0
+                     for p in parts])
+    mat = np.asarray(rows, dtype=np.float64)
+    if mat.size == 0:
+        return np.zeros((0,)), np.zeros((0, 0))
+    if label_idx >= 0:
+        label = mat[:, label_idx]
+        feats = np.delete(mat, label_idx, axis=1)
+    else:
+        label = np.zeros(mat.shape[0])
+        feats = mat
+    return label, feats
+
+
+def _parse_libsvm(lines: List[str], num_features: Optional[int] = None
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    labels = []
+    entries = []  # (row, col, value)
+    max_col = -1
+    row = 0
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        parts = line.split()
+        start = 0
+        if ":" not in parts[0]:
+            labels.append(float(parts[0]))
+            start = 1
+        else:
+            labels.append(0.0)
+        for tok in parts[start:]:
+            col_s, val_s = tok.split(":", 1)
+            col = int(col_s)
+            max_col = max(max_col, col)
+            entries.append((row, col, float(val_s)))
+        row += 1
+    ncol = num_features if num_features is not None else max_col + 1
+    feats = np.zeros((row, max(ncol, 0)), dtype=np.float64)
+    for r, c, v in entries:
+        if c < feats.shape[1]:
+            feats[r, c] = v
+    return np.asarray(labels, dtype=np.float64), feats
+
+
+def parse_file(path: str, has_header: bool = False, label_idx: int = 0,
+               num_features: Optional[int] = None
+               ) -> Tuple[np.ndarray, np.ndarray, Optional[List[str]]]:
+    """Parse a data file.  Returns (label, features[N,F], header_names)."""
+    with open(path, "r") as fh:
+        lines = fh.read().splitlines()
+    header: Optional[List[str]] = None
+    probe = [ln for ln in lines[:32] if ln.strip()]
+    fmt = detect_format(probe[1:] if has_header else probe)
+    if has_header and lines:
+        delim = {"csv": ",", "tsv": "\t"}.get(fmt, "\t")
+        header = lines[0].split(delim)
+        lines = lines[1:]
+    if fmt == "libsvm":
+        label, feats = _parse_libsvm(lines, num_features)
+    else:
+        delim = "," if fmt == "csv" else "\t"
+        label, feats = _parse_delimited(lines, delim, label_idx)
+    return label, feats, header

@@ -1,0 +1,40 @@
+"""Leveled logger gated by ``verbose`` (reference include/LightGBM/utils/log.h)."""
+
+from __future__ import annotations
+
+import sys
+
+_LEVELS = {"fatal": -1, "warning": 0, "info": 1, "debug": 2}
+_current_level = 1
+
+
+def set_verbosity(verbose: int) -> None:
+    global _current_level
+    _current_level = int(verbose)
+
+
+def _emit(tag: str, level: int, msg: str, *args) -> None:
+    if level <= _current_level:
+        text = msg % args if args else msg
+        print(f"[LightGBM-TPU] [{tag}] {text}", file=sys.stderr, flush=True)
+
+
+def debug(msg: str, *args) -> None:
+    _emit("Debug", 2, msg, *args)
+
+
+def info(msg: str, *args) -> None:
+    _emit("Info", 1, msg, *args)
+
+
+def warning(msg: str, *args) -> None:
+    _emit("Warning", 0, msg, *args)
+
+
+class LightGBMError(Exception):
+    """Raised where the reference would Log::Fatal."""
+
+
+def fatal(msg: str, *args) -> None:
+    text = msg % args if args else msg
+    raise LightGBMError(text)

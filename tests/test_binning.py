@@ -1,0 +1,111 @@
+import numpy as np
+import pytest
+
+from lightgbm_tpu.io.binning import BinMapper, CATEGORICAL, NUMERICAL
+
+
+def test_distinct_value_fast_path():
+    # 4 distinct values, plenty of max_bin: each distinct value its own bin,
+    # boundaries at midpoints (bin.cpp:116-131).
+    vals = np.repeat([1.0, 2.0, 3.0, 4.0], 10)
+    m = BinMapper().find_bin(vals, total_sample_cnt=40, max_bin=255,
+                             min_data_in_bin=1, min_split_data=1)
+    assert m.num_bin == 4
+    np.testing.assert_allclose(m.bin_upper_bound[:-1], [1.5, 2.5, 3.5])
+    assert np.isinf(m.bin_upper_bound[-1])
+    assert not m.is_trivial
+    bins = m.value_to_bin([0.5, 1.0, 1.6, 2.5, 3.9, 100.0])
+    np.testing.assert_array_equal(bins, [0, 0, 1, 1, 3, 3])
+
+
+def test_zero_handling_inserted():
+    # zeros implied by total_sample_cnt > len(values) get their own distinct
+    # value spliced into sorted position (bin.cpp:83-110).
+    vals = np.array([1.0, 1.0, 2.0, 2.0])
+    m = BinMapper().find_bin(vals, total_sample_cnt=10, max_bin=255,
+                             min_data_in_bin=1, min_split_data=1)
+    # distinct = [0, 1, 2]
+    assert m.num_bin == 3
+    assert m.value_to_bin(0.0) == 0
+    assert m.default_bin == 0
+
+
+def test_zero_between_negative_positive():
+    vals = np.array([-2.0, -1.0, 1.0, 2.0])
+    m = BinMapper().find_bin(vals, total_sample_cnt=8, max_bin=255,
+                             min_data_in_bin=1, min_split_data=1)
+    # distinct = [-2, -1, 0, 1, 2]
+    assert m.num_bin == 5
+    assert m.default_bin == m.value_to_bin(0.0) == 2
+
+
+def test_greedy_equal_count():
+    rng = np.random.RandomState(0)
+    vals = rng.uniform(0.001, 1.0, size=10000)
+    m = BinMapper().find_bin(vals, total_sample_cnt=10000, max_bin=16,
+                             min_data_in_bin=1, min_split_data=1)
+    assert 2 <= m.num_bin <= 16
+    bins = m.value_to_bin(vals)
+    counts = np.bincount(bins, minlength=m.num_bin)
+    # roughly equal-count: no bin is more than 3x the mean
+    assert counts.max() < 3 * counts.mean()
+    # bins are monotone in value
+    order = np.argsort(vals)
+    assert np.all(np.diff(bins[order]) >= 0)
+
+
+def test_min_data_in_bin_merges():
+    vals = np.concatenate([np.repeat(1.0, 100), np.repeat(2.0, 2),
+                           np.repeat(3.0, 100)])
+    m = BinMapper().find_bin(vals, total_sample_cnt=202, max_bin=255,
+                             min_data_in_bin=5, min_split_data=1)
+    # value 2.0 alone has < 5 samples, so it merges with 3.0's bin
+    assert m.num_bin == 2
+    assert m.value_to_bin(2.0) == m.value_to_bin(3.0) == 1
+
+
+def test_trivial_single_value():
+    vals = np.repeat(5.0, 50)
+    m = BinMapper().find_bin(vals, total_sample_cnt=50, max_bin=255,
+                             min_data_in_bin=1, min_split_data=1)
+    assert m.is_trivial
+
+
+def test_trivial_filter_min_split_data():
+    # 3 rows total but min_split_data=10: no usable split (bin.cpp:47-69).
+    vals = np.array([1.0, 2.0, 3.0])
+    m = BinMapper().find_bin(vals, total_sample_cnt=3, max_bin=255,
+                             min_data_in_bin=1, min_split_data=10)
+    assert m.is_trivial
+
+
+def test_categorical_basic():
+    vals = np.repeat([3.0, 7.0, 7.0, 9.0], [50, 30, 70, 20])
+    m = BinMapper().find_bin(vals, total_sample_cnt=170, max_bin=255,
+                             min_data_in_bin=1, min_split_data=1,
+                             bin_type=CATEGORICAL)
+    # sorted by count desc: 7 (100), 3 (50), 9 (20)
+    assert m.bin_2_categorical[0] == 7
+    assert m.value_to_bin(7.0) == 0
+    assert m.value_to_bin(3.0) == 1
+    assert m.value_to_bin(9.0) == 2
+    # unseen category maps to last bin (bin.h:400-406)
+    assert m.value_to_bin(12345.0) == m.num_bin - 1
+
+
+def test_roundtrip_state():
+    vals = np.random.RandomState(1).normal(size=500)
+    m = BinMapper().find_bin(vals, total_sample_cnt=600, max_bin=32,
+                             min_data_in_bin=3, min_split_data=2)
+    m2 = BinMapper.from_state(m.to_state())
+    x = np.linspace(-3, 3, 101)
+    np.testing.assert_array_equal(m.value_to_bin(x), m2.value_to_bin(x))
+    assert m2.default_bin == m.default_bin
+
+
+def test_bin_to_value_upper_bound():
+    vals = np.repeat([1.0, 2.0, 4.0], 10)
+    m = BinMapper().find_bin(vals, total_sample_cnt=30, max_bin=255,
+                             min_data_in_bin=1, min_split_data=1)
+    assert m.bin_to_value(0) == pytest.approx(1.5)
+    assert m.bin_to_value(1) == pytest.approx(3.0)

@@ -1,0 +1,82 @@
+"""Property test: the O(max_bin) skip-ahead greedy binning must produce the
+same boundaries as a straight per-distinct-value transcription of the
+reference scan (bin.cpp:132-191)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.io.binning import BinMapper
+
+
+def _reference_greedy(distinct_values, counts, total_sample_cnt, max_bin,
+                      min_data_in_bin, zero_cnt, num_sample_values):
+    # Direct re-statement of bin.cpp:132-191 for testing only.
+    if min_data_in_bin > 0:
+        max_bin = max(1, min(max_bin, total_sample_cnt // min_data_in_bin))
+    mean_bin_size = total_sample_cnt / max_bin
+    if zero_cnt > mean_bin_size:
+        max_bin = min(max_bin, 1 + num_sample_values // max(1, min_data_in_bin))
+    num_distinct = len(distinct_values)
+    rest_bin_cnt = max_bin
+    rest_sample_cnt = total_sample_cnt
+    is_big = [c >= mean_bin_size for c in counts]
+    for i in range(num_distinct):
+        if is_big[i]:
+            rest_bin_cnt -= 1
+            rest_sample_cnt -= counts[i]
+    mean_bin_size = rest_sample_cnt / max(1, rest_bin_cnt)
+    upper_bounds = [np.inf] * max_bin
+    lower_bounds = [np.inf] * max_bin
+    bin_cnt = 0
+    lower_bounds[0] = distinct_values[0]
+    cur_cnt = 0
+    for i in range(num_distinct - 1):
+        if not is_big[i]:
+            rest_sample_cnt -= counts[i]
+        cur_cnt += counts[i]
+        if (is_big[i] or cur_cnt >= mean_bin_size or
+                (is_big[i + 1] and cur_cnt >= max(1.0, mean_bin_size * 0.5))):
+            upper_bounds[bin_cnt] = distinct_values[i]
+            bin_cnt += 1
+            lower_bounds[bin_cnt] = distinct_values[i + 1]
+            if bin_cnt >= max_bin - 1:
+                break
+            cur_cnt = 0
+            if not is_big[i]:
+                rest_bin_cnt -= 1
+                mean_bin_size = rest_sample_cnt / max(1, rest_bin_cnt)
+    bin_cnt += 1
+    bounds = [np.inf] * bin_cnt
+    for i in range(bin_cnt - 1):
+        bounds[i] = (upper_bounds[i] + lower_bounds[i + 1]) / 2.0
+    return np.asarray(bounds)
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("max_bin", [4, 16, 63, 255])
+def test_greedy_matches_reference_scan(seed, max_bin):
+    rng = np.random.RandomState(seed)
+    # mixture: continuous + repeated spikes + negatives, plus implied zeros
+    n = rng.randint(500, 4000)
+    vals = np.concatenate([
+        rng.normal(size=n),
+        np.repeat(rng.choice([-1.5, 0.25, 3.0], 3, replace=False),
+                  rng.randint(50, 400, size=3)),
+    ])
+    vals = vals[vals != 0.0]
+    zero_cnt = rng.randint(0, 500)
+    total = len(vals) + zero_cnt
+
+    m = BinMapper().find_bin(vals, total, max_bin, min_data_in_bin=3,
+                             min_split_data=1)
+    if m.num_bin >= len(np.unique(vals)) + 1:
+        pytest.skip("hit distinct fast path")
+
+    uniq, ucnt = np.unique(vals, return_counts=True)
+    if zero_cnt > 0 and 0.0 not in uniq:
+        pos = int(np.searchsorted(uniq, 0.0))
+        uniq = np.insert(uniq, pos, 0.0)
+        ucnt = np.insert(ucnt, pos, zero_cnt)
+    expected = _reference_greedy(uniq.tolist(), ucnt.tolist(), total, max_bin,
+                                 3, zero_cnt, len(vals))
+    np.testing.assert_allclose(m.bin_upper_bound, expected)

@@ -1,0 +1,101 @@
+import pytest
+
+from lightgbm_tpu.config import Config, apply_aliases, parse_cli_args
+
+
+def test_aliases_resolve():
+    params = apply_aliases({"num_tree": 50, "min_child_samples": 7,
+                            "colsample_bytree": 0.5})
+    assert params == {"num_iterations": 50, "min_data_in_leaf": 7,
+                      "feature_fraction": 0.5}
+
+
+def test_canonical_wins_over_alias():
+    params = apply_aliases({"num_iterations": 10, "num_round": 99})
+    assert params["num_iterations"] == 10
+
+
+def test_defaults():
+    cfg = Config()
+    assert cfg.num_leaves == 127
+    assert cfg.max_bin == 255
+    assert cfg.learning_rate == pytest.approx(0.1)
+    assert cfg.min_data_in_leaf == 100
+    assert cfg.min_sum_hessian_in_leaf == pytest.approx(10.0)
+    assert cfg.objective == "regression"
+    assert cfg.metric == ["l2"]  # derived from objective
+
+
+def test_metric_defaults_from_objective():
+    assert Config({"objective": "binary"}).metric == ["binary_logloss"]
+    assert Config({"objective": "lambdarank"}).metric == ["ndcg"]
+    assert Config({"objective": "multiclass", "num_class": 3}).metric == ["multi_logloss"]
+
+
+def test_objective_aliases():
+    assert Config({"objective": "mse"}).objective == "regression"
+    assert Config({"objective": "mae"}).objective == "regression_l1"
+
+
+def test_type_coercion_from_strings():
+    cfg = Config({"num_leaves": "31", "learning_rate": "0.05",
+                  "is_unbalance": "true", "metric": "l2,auc"})
+    assert cfg.num_leaves == 31
+    assert cfg.learning_rate == pytest.approx(0.05)
+    assert cfg.is_unbalance is True
+    assert cfg.metric == ["l2", "auc"]
+
+
+def test_conflicts():
+    with pytest.raises(ValueError):
+        Config({"objective": "multiclass", "num_class": 1})
+    with pytest.raises(ValueError):
+        Config({"num_leaves": 1})
+    with pytest.raises(ValueError):
+        Config({"tree_learner": "bogus"})
+    with pytest.raises(ValueError):
+        Config({"boosting_type": "goss", "bagging_fraction": 0.5,
+                "bagging_freq": 1})
+
+
+def test_max_depth_caps_leaves():
+    cfg = Config({"max_depth": 3, "num_leaves": 127})
+    assert cfg.num_leaves == 8
+
+
+def test_parallel_derivation():
+    cfg = Config({"tree_learner": "data", "num_machines": 4})
+    assert cfg.is_parallel and cfg.is_parallel_find_bin
+    cfg = Config({"tree_learner": "data", "num_machines": 1})
+    assert not cfg.is_parallel
+    cfg = Config({"tree_learner": "feature", "num_machines": 2})
+    assert cfg.is_parallel and not cfg.is_parallel_find_bin
+
+
+def test_parse_cli_args_and_config_file(tmp_path):
+    conf = tmp_path / "train.conf"
+    conf.write_text("task = train\n# comment\nnum_trees = 25\n"
+                    "objective = binary  # trailing comment\n")
+    params = parse_cli_args([f"config={conf}", "num_leaves=31"])
+    cfg = Config(params)
+    assert cfg.num_iterations == 25
+    assert cfg.objective == "binary"
+    assert cfg.num_leaves == 31
+
+
+def test_multiclass_requires_more_than_two_classes():
+    with pytest.raises(ValueError):
+        Config({"objective": "multiclass", "num_class": 2})
+    assert Config({"objective": "multiclass", "num_class": 3}).num_class == 3
+
+
+def test_tree_learner_normalized_to_serial():
+    cfg = Config({"tree_learner": "data", "num_machines": 1})
+    assert cfg.tree_learner == "serial"
+
+
+def test_objective_metric_mismatch():
+    with pytest.raises(ValueError):
+        Config({"objective": "binary", "metric": "multi_logloss"})
+    with pytest.raises(ValueError):
+        Config({"objective": "multiclass", "num_class": 3, "metric": "auc"})

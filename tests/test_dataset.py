@@ -1,0 +1,94 @@
+import numpy as np
+
+from lightgbm_tpu.io.dataset import BinnedDataset, Metadata
+
+
+def _toy(n=500, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    X[:, 3] = 1.0  # trivial feature
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def test_from_matrix_shapes_and_trivial_drop():
+    X, y = _toy()
+    ds = BinnedDataset.from_matrix(X, y, max_bin=63, min_data_in_leaf=5)
+    assert ds.num_total_features == 6
+    assert ds.num_features == 5  # trivial column dropped
+    assert ds.real_to_inner[3] == -1
+    assert ds.bins.shape == (5, 500)
+    assert ds.bins.dtype == np.uint8
+    assert (ds.num_bin_per_feature() <= 63).all()
+
+
+def test_bins_monotone_in_value():
+    X, y = _toy()
+    ds = BinnedDataset.from_matrix(X, y, max_bin=16, min_data_in_leaf=5)
+    col = X[:, 0]
+    bins = ds.bins[ds.real_to_inner[0]]
+    order = np.argsort(col)
+    assert np.all(np.diff(bins[order].astype(int)) >= 0)
+
+
+def test_create_valid_aligned():
+    X, y = _toy()
+    Xv, yv = _toy(seed=1)
+    ds = BinnedDataset.from_matrix(X, y, max_bin=32, min_data_in_leaf=5)
+    valid = ds.create_valid(Xv, yv)
+    assert valid.bins.shape[0] == ds.bins.shape[0]
+    # same mapper objects => identical binning of identical values
+    f0 = ds.used_feature_map[0]
+    np.testing.assert_array_equal(
+        valid.bins[0], ds.mappers[0].value_to_bin(Xv[:, f0]).astype(ds.bins.dtype))
+
+
+def test_subset():
+    X, y = _toy()
+    ds = BinnedDataset.from_matrix(X, y, max_bin=32, min_data_in_leaf=5)
+    ds.metadata.set_weights(np.arange(500, dtype=np.float64))
+    idx = np.arange(0, 500, 5)
+    sub = ds.subset(idx)
+    assert sub.num_data == 100
+    np.testing.assert_array_equal(sub.bins, ds.bins[:, idx])
+    np.testing.assert_allclose(sub.metadata.weights, np.arange(0, 500, 5))
+
+
+def test_binary_roundtrip(tmp_path):
+    X, y = _toy()
+    ds = BinnedDataset.from_matrix(X, y, max_bin=32, min_data_in_leaf=5)
+    path = str(tmp_path / "ds.bin")
+    ds.save_binary(path)
+    assert BinnedDataset.is_binary_file(path)
+    ds2 = BinnedDataset.load_binary(path)
+    np.testing.assert_array_equal(ds.bins, ds2.bins)
+    np.testing.assert_allclose(ds.metadata.label, ds2.metadata.label)
+    assert ds2.feature_infos() == ds.feature_infos()
+
+
+def test_metadata_query():
+    md = Metadata(10)
+    md.set_query([3, 3, 4])
+    np.testing.assert_array_equal(md.query_boundaries, [0, 3, 6, 10])
+    md2 = Metadata(6)
+    md2.set_query_id([1, 1, 2, 2, 2, 5])
+    np.testing.assert_array_equal(md2.query_boundaries, [0, 2, 5, 6])
+
+
+def test_subset_rebuilds_query_boundaries():
+    X, y = _toy(n=100)
+    ds = BinnedDataset.from_matrix(X, y, max_bin=16, min_data_in_leaf=5)
+    ds.metadata.set_query([30, 30, 40])
+    sub = ds.subset(np.arange(25, 70))  # spans queries 0..2 partially
+    np.testing.assert_array_equal(sub.metadata.query_boundaries, [0, 5, 35, 45])
+
+
+def test_filter_cnt_scaled_to_sample():
+    # 150 rows, min_data_in_leaf=100: reference filter_cnt = 0.95*100/150*150
+    # = 95 < 150, so a balanced feature must survive (it would be wrongly
+    # dropped if the unscaled min_data_in_leaf were used).
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(150, 2))
+    y = np.zeros(150)
+    ds = BinnedDataset.from_matrix(X, y, max_bin=16, min_data_in_leaf=100)
+    assert ds.num_features == 2
