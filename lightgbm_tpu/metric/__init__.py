@@ -1,0 +1,313 @@
+"""Evaluation metrics.
+
+Mirrors src/metric/ (factory metric.cpp:10-37).  Metrics run host-side in
+float64 once per eval on scores copied from device — exactness matters more
+than speed here (the hot path is training, not eval), and float64 matches
+the reference's double accumulators.
+
+``factor_to_bigger_better``: +1 when bigger is better (auc/ndcg/map), -1
+otherwise — drives early stopping (gbdt.cpp:493).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import log
+from ..io.dataset import Metadata
+
+
+class Metric:
+    names: List[str] = []
+    factor_to_bigger_better = -1.0
+
+    def init(self, metadata: Metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = np.asarray(metadata.label, np.float64)
+        self.weights = (None if metadata.weights is None
+                        else np.asarray(metadata.weights, np.float64))
+        self.sum_weights = (float(num_data) if self.weights is None
+                            else float(self.weights.sum()))
+
+    def eval(self, score: np.ndarray) -> List[float]:
+        """score: [K, N] class-major raw scores."""
+        raise NotImplementedError
+
+
+class _PointwiseRegressionMetric(Metric):
+    """CRTP RegressionMetric equivalent (regression_metric.hpp:16-93)."""
+
+    def _loss(self, label, score):
+        raise NotImplementedError
+
+    def eval(self, score):
+        loss = self._loss(self.label, score[0])
+        if self.weights is not None:
+            loss = loss * self.weights
+        return [float(loss.sum() / self.sum_weights)]
+
+
+class L2Metric(_PointwiseRegressionMetric):
+    """NOTE: the reference's "l2" metric reports sqrt(MSE), i.e. RMSE
+    (L2Metric::AverageLoss, regression_metric.hpp:103-106)."""
+    names = ["l2"]
+
+    def _loss(self, label, score):
+        return (score - label) ** 2
+
+    def eval(self, score):
+        return [float(np.sqrt(super().eval(score)[0]))]
+
+
+class L1Metric(_PointwiseRegressionMetric):
+    names = ["l1"]
+
+    def _loss(self, label, score):
+        return np.abs(score - label)
+
+
+class HuberLossMetric(_PointwiseRegressionMetric):
+    names = ["huber"]
+
+    def __init__(self, config):
+        self.delta = float(config.huber_delta)
+
+    def _loss(self, label, score):
+        diff = score - label
+        return np.where(np.abs(diff) <= self.delta,
+                        0.5 * diff * diff,
+                        self.delta * (np.abs(diff) - 0.5 * self.delta))
+
+
+class FairLossMetric(_PointwiseRegressionMetric):
+    names = ["fair"]
+
+    def __init__(self, config):
+        self.c = float(config.fair_c)
+
+    def _loss(self, label, score):
+        x = np.abs(score - label)
+        c = self.c
+        return c * x - c * c * np.log(1.0 + x / c)
+
+
+class PoissonMetric(_PointwiseRegressionMetric):
+    names = ["poisson"]
+
+    def _loss(self, label, score):
+        eps = 1e-10
+        return np.where(score < eps, label * np.log(eps) - eps,
+                        label * np.log(score) - score) * -1.0
+
+
+class BinaryLoglossMetric(Metric):
+    """binary_metric.hpp:19-139 with sigmoid prob transform."""
+    names = ["binary_logloss"]
+
+    def __init__(self, config):
+        self.sigmoid = float(config.sigmoid)
+
+    def eval(self, score):
+        prob = 1.0 / (1.0 + np.exp(-self.sigmoid * score[0]))
+        eps = 1e-15
+        prob = np.clip(prob, eps, 1.0 - eps)
+        is_pos = self.label > 0
+        loss = np.where(is_pos, -np.log(prob), -np.log(1.0 - prob))
+        if self.weights is not None:
+            loss = loss * self.weights
+        return [float(loss.sum() / self.sum_weights)]
+
+
+class BinaryErrorMetric(Metric):
+    names = ["binary_error"]
+
+    def __init__(self, config):
+        self.sigmoid = float(config.sigmoid)
+
+    def eval(self, score):
+        pred_pos = score[0] > 0
+        is_pos = self.label > 0
+        err = (pred_pos != is_pos).astype(np.float64)
+        if self.weights is not None:
+            err = err * self.weights
+        return [float(err.sum() / self.sum_weights)]
+
+
+class AUCMetric(Metric):
+    """Single-pass weighted AUC with tie handling (binary_metric.hpp:145-252)."""
+    names = ["auc"]
+    factor_to_bigger_better = 1.0
+
+    def eval(self, score):
+        s = score[0]
+        w = self.weights if self.weights is not None else np.ones_like(s)
+        order = np.argsort(-s, kind="stable")
+        lbl = self.label[order] > 0
+        ws = w[order]
+        pos = np.where(lbl, ws, 0.0)
+        neg = np.where(~lbl, ws, 0.0)
+        # group by tied score
+        ss = s[order]
+        new_group = np.empty(len(ss), bool)
+        new_group[0] = True
+        new_group[1:] = ss[1:] != ss[:-1]
+        gid = np.cumsum(new_group) - 1
+        ngroups = gid[-1] + 1
+        pos_g = np.bincount(gid, weights=pos, minlength=ngroups)
+        neg_g = np.bincount(gid, weights=neg, minlength=ngroups)
+        sum_pos_before = np.cumsum(pos_g) - pos_g
+        accum = float((neg_g * (pos_g * 0.5 + sum_pos_before)).sum())
+        sum_pos = float(pos_g.sum())
+        if sum_pos > 0.0 and sum_pos != self.sum_weights:
+            return [accum / (sum_pos * (self.sum_weights - sum_pos))]
+        return [1.0]
+
+
+class MultiLoglossMetric(Metric):
+    """multiclass_metric.hpp:16-139."""
+    names = ["multi_logloss"]
+
+    def __init__(self, config):
+        self.num_class = int(config.num_class)
+
+    def eval(self, score):
+        # score [K, N]
+        p = np.exp(score - score.max(axis=0, keepdims=True))
+        p = p / p.sum(axis=0, keepdims=True)
+        idx = self.label.astype(np.int64)
+        prob_true = np.clip(p[idx, np.arange(len(idx))], 1e-15, None)
+        loss = -np.log(prob_true)
+        if self.weights is not None:
+            loss = loss * self.weights
+        return [float(loss.sum() / self.sum_weights)]
+
+
+class MultiErrorMetric(Metric):
+    names = ["multi_error"]
+
+    def __init__(self, config):
+        self.num_class = int(config.num_class)
+
+    def eval(self, score):
+        pred = score.argmax(axis=0)
+        err = (pred != self.label.astype(np.int64)).astype(np.float64)
+        if self.weights is not None:
+            err = err * self.weights
+        return [float(err.sum() / self.sum_weights)]
+
+
+class _RankMetricBase(Metric):
+    factor_to_bigger_better = 1.0
+
+    def __init__(self, config):
+        self.eval_at = [int(k) for k in config.ndcg_eval_at] or [1, 2, 3, 4, 5]
+        from ..objective import default_label_gain
+        gains = list(config.label_gain) or default_label_gain()
+        self.label_gain = np.asarray(gains, np.float64)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            log.fatal("%s metric requires query information", self.names[0])
+        self.query_boundaries = np.asarray(metadata.query_boundaries, np.int64)
+        self.num_queries = len(self.query_boundaries) - 1
+        self.query_weights = metadata.query_weights
+        self.sum_query_weights = (float(self.num_queries)
+                                  if self.query_weights is None
+                                  else float(self.query_weights.sum()))
+
+
+class NDCGMetric(_RankMetricBase):
+    """NDCG@k averaged over queries with query weights
+    (rank_metric.hpp:16-169, dcg_calculator.cpp)."""
+
+    names = ["ndcg"]
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.names = [f"ndcg@{k}" for k in self.eval_at]
+
+    def eval(self, score):
+        s = score[0]
+        qb = self.query_boundaries
+        results = np.zeros(len(self.eval_at), np.float64)
+        for q in range(self.num_queries):
+            lbl = self.label[qb[q]:qb[q + 1]].astype(np.int64)
+            sc = s[qb[q]:qb[q + 1]]
+            n = len(lbl)
+            disc = 1.0 / np.log2(np.arange(n) + 2.0)
+            qw = 1.0 if self.query_weights is None else self.query_weights[q]
+            order = np.argsort(-sc, kind="stable")
+            ideal = np.sort(lbl)[::-1]
+            for i, k in enumerate(self.eval_at):
+                kk = min(k, n)
+                max_dcg = (self.label_gain[ideal[:kk]] * disc[:kk]).sum()
+                if max_dcg <= 0.0:
+                    results[i] += 1.0 * qw  # no relevant docs -> 1 (ref)
+                else:
+                    dcg = (self.label_gain[lbl[order[:kk]]] * disc[:kk]).sum()
+                    results[i] += dcg / max_dcg * qw
+        return [float(r / self.sum_query_weights) for r in results]
+
+
+class MapMetric(_RankMetricBase):
+    """MAP@k (map_metric.hpp:16-157)."""
+
+    names = ["map"]
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.names = [f"map@{k}" for k in self.eval_at]
+
+    def eval(self, score):
+        s = score[0]
+        qb = self.query_boundaries
+        results = np.zeros(len(self.eval_at), np.float64)
+        for q in range(self.num_queries):
+            lbl = self.label[qb[q]:qb[q + 1]] > 0
+            sc = s[qb[q]:qb[q + 1]]
+            qw = 1.0 if self.query_weights is None else self.query_weights[q]
+            order = np.argsort(-sc, kind="stable")
+            rel = lbl[order]
+            hits = np.cumsum(rel)
+            prec = hits / (np.arange(len(rel)) + 1.0)
+            for i, k in enumerate(self.eval_at):
+                kk = min(k, len(rel))
+                num_hits = hits[kk - 1] if kk > 0 else 0
+                if num_hits > 0:
+                    ap = (prec[:kk] * rel[:kk]).sum() / num_hits
+                else:
+                    ap = 0.0
+                results[i] += ap * qw
+        return [float(r / self.sum_query_weights) for r in results]
+
+
+_METRICS = {
+    "l2": L2Metric,
+    "l1": L1Metric,
+    "huber": HuberLossMetric,
+    "fair": FairLossMetric,
+    "poisson": PoissonMetric,
+    "binary_logloss": BinaryLoglossMetric,
+    "binary_error": BinaryErrorMetric,
+    "auc": AUCMetric,
+    "multi_logloss": MultiLoglossMetric,
+    "multi_error": MultiErrorMetric,
+    "ndcg": NDCGMetric,
+    "map": MapMetric,
+}
+
+
+def create_metric(name: str, config) -> Optional[Metric]:
+    """Factory (metric.cpp:10-37); returns None for 'none'."""
+    if name in ("", "none", "null", "na"):
+        return None
+    if name not in _METRICS:
+        log.fatal("Unknown metric type name: %s", name)
+    cls = _METRICS[name]
+    try:
+        return cls(config)
+    except TypeError:
+        return cls()

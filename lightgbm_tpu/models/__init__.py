@@ -1,0 +1,23 @@
+from .tree import Tree  # noqa: F401
+from .gbdt import GBDT  # noqa: F401
+from .dart import DART  # noqa: F401
+from .goss import GOSS  # noqa: F401
+
+
+def create_boosting(config, train_set=None, objective=None,
+                    model_str: str = ""):
+    """Boosting factory (boosting.cpp:8-71): type string or a model string
+    whose first line names the submodel."""
+    boosting_type = config.boosting_type
+    if model_str:
+        first = model_str.strip().splitlines()[0].strip()
+        if first in ("gbdt", "dart", "goss", "tree"):
+            boosting_type = "gbdt" if first == "tree" else first
+    cls = {"gbdt": GBDT, "dart": DART, "goss": GOSS}.get(boosting_type)
+    if cls is None:
+        from ..utils import log
+        log.fatal("Unknown boosting type %s", boosting_type)
+    model = cls(config, train_set)
+    if model_str:
+        model.load_model_from_string(model_str)
+    return model

@@ -1,0 +1,126 @@
+"""DART: Dropouts meet Multiple Additive Regression Trees.
+
+Reference: src/boosting/dart.hpp.  Per iteration: drop a random subset of
+trees (weighted by tree weight unless uniform_drop; skip probability
+skip_drop; cap max_drop), compute gradients against the dropped score, train
+with shrinkage lr/(1+k), then Normalize: scale the dropped trees by
+k/(k+1) (or the xgboost-mode variant) and patch train/valid scores
+(dart.hpp:84-178).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..utils import log
+from .gbdt import GBDT, _negate_tree
+from .tree import Tree
+
+
+class DART(GBDT):
+    submodel_name = "dart"
+
+    def __init__(self, config, train_set, objective=None):
+        super().__init__(config, train_set, objective)
+        self.drop_rate = config.drop_rate
+        self.max_drop = config.max_drop
+        self.skip_drop = config.skip_drop
+        self.uniform_drop = config.uniform_drop
+        self.xgboost_dart_mode = config.xgboost_dart_mode
+        self._drop_rng = np.random.RandomState(config.drop_seed)
+        self.tree_weights: List[float] = []
+        self.sum_weight = 0.0
+        self.drop_index: List[int] = []
+        self.shrinkage_rate = config.learning_rate
+
+    # -- drop bookkeeping (dart.hpp:84-137) ------------------------------
+    def _select_dropping_trees(self) -> None:
+        self.drop_index = []
+        num_iters = self.iter_
+        if num_iters <= 0:
+            self.shrinkage_rate = self.config.learning_rate
+            return
+        if self._drop_rng.uniform() < self.skip_drop:
+            # skip dropout this round
+            self.shrinkage_rate = self.config.learning_rate
+            return
+        rate = self.drop_rate
+        if self.uniform_drop:
+            for i in range(num_iters):
+                if self._drop_rng.uniform() < rate:
+                    self.drop_index.append(i)
+        else:
+            inv_avg = num_iters / max(self.sum_weight, 1e-12)
+            for i in range(num_iters):
+                if self._drop_rng.uniform() < rate * self.tree_weights[i] * inv_avg:
+                    self.drop_index.append(i)
+        if len(self.drop_index) > self.max_drop:
+            keep = self._drop_rng.choice(len(self.drop_index), self.max_drop,
+                                         replace=False)
+            self.drop_index = [self.drop_index[i] for i in sorted(keep)]
+        k = len(self.drop_index)
+        self.shrinkage_rate = self.config.learning_rate / (1.0 + k)
+
+    def _apply_drop(self) -> None:
+        """Subtract dropped trees from all scores."""
+        for it in self.drop_index:
+            for cls in range(self.num_class):
+                tree = self.models[it * self.num_class + cls]
+                neg = _negate_tree(tree)
+                self._add_host_tree_to(self.train_data, neg, cls)
+                for dd in self.valid_data:
+                    self._add_host_tree_to(dd, neg, cls)
+
+    def _normalize(self) -> None:
+        """dart.hpp:139-178: re-add dropped trees scaled by k/(k+1)."""
+        k = len(self.drop_index)
+        new_tree_idx = self.iter_ - 1  # tree just trained
+        if self.xgboost_dart_mode:
+            scale_new = self.shrinkage_rate  # lr/(1+k) already applied at train
+            factor_dropped = k / (k + 1.0)
+        else:
+            factor_dropped = k / (k + 1.0)
+        # new tree already added with shrinkage lr/(1+k): matches reference,
+        # which shrinks by shrinkage_rate_ then Normalize.
+        for it in self.drop_index:
+            for cls in range(self.num_class):
+                idx = it * self.num_class + cls
+                tree = self.models[idx]
+                # scale tree in place by factor, and add back factor * tree
+                scaled = _scale_tree(tree, factor_dropped)
+                self.models[idx] = scaled
+                self._add_host_tree_to(self.train_data, scaled, cls)
+                for dd in self.valid_data:
+                    self._add_host_tree_to(dd, scaled, cls)
+                self.tree_weights[it] *= factor_dropped
+        # weight bookkeeping for the new tree
+        if k > 0:
+            self.sum_weight = sum(self.tree_weights)
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        self._select_dropping_trees()
+        self._apply_drop()
+        stop = super().train_one_iter(grad, hess)
+        if not stop:
+            self.tree_weights.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+            self._normalize()
+        else:
+            # training produced no tree: restore dropped trees untouched
+            for it in self.drop_index:
+                for cls in range(self.num_class):
+                    tree = self.models[it * self.num_class + cls]
+                    self._add_host_tree_to(self.train_data, tree, cls)
+                    for dd in self.valid_data:
+                        self._add_host_tree_to(dd, tree, cls)
+        return stop
+
+
+def _scale_tree(tree: Tree, factor: float) -> Tree:
+    import copy
+    out = copy.deepcopy(tree)
+    out.leaf_value = out.leaf_value * factor
+    out.shrinkage *= factor
+    return out

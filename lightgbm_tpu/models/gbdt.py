@@ -1,0 +1,435 @@
+"""GBDT boosting engine: the full training loop state machine.
+
+Reference: src/boosting/gbdt.{h,cpp}.  One boosting iteration
+(GBDT::TrainOneIter, gbdt.cpp:295-382) becomes: a jitted objective pass, a
+host-side bagging/feature-fraction mask draw, one jitted whole-tree growth
+per class (ops/grow.py), and jitted score updates — scores never leave the
+device during training; metrics pull them once per eval.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+from ..io.dataset import BinnedDataset
+from ..metric import Metric, create_metric
+from ..objective import ObjectiveFunction, create_objective
+from ..ops.grow import GrowParams, grow_tree
+from ..ops.predict import predict_binned_forest, predict_binned_tree
+from ..utils import log
+from .tree import Tree
+
+
+class _DeviceData:
+    """Device-resident binned dataset + per-dataset score buffer
+    (ScoreUpdater, score_updater.hpp:23-99)."""
+
+    def __init__(self, dataset: BinnedDataset, num_models: int):
+        self.dataset = dataset
+        self.bins = jnp.asarray(dataset.bins.astype(np.int32))
+        self.num_data = dataset.num_data
+        init = np.zeros((num_models, self.num_data), np.float32)
+        if dataset.metadata.init_score is not None:
+            init += np.asarray(dataset.metadata.init_score,
+                               np.float32).reshape(num_models, self.num_data)
+        self.score = jnp.asarray(init)
+
+    def add_tree(self, tree_arrays, is_cat, cls: int, max_steps: int):
+        n = tree_arrays.split_feature.shape[0]
+        delta, _ = predict_binned_tree(
+            tree_arrays.split_feature, tree_arrays.split_bin,
+            is_cat[jnp.maximum(tree_arrays.split_feature, 0)],
+            tree_arrays.left_child, tree_arrays.right_child,
+            tree_arrays.leaf_value, self.bins, max_steps)
+        self.score = self.score.at[cls].add(delta)
+
+
+class GBDT:
+    """Gradient Boosting Decision Tree (reference gbdt.h:20-351)."""
+
+    submodel_name = "gbdt"
+
+    def __init__(self, config: Config, train_set: Optional[BinnedDataset],
+                 objective: Optional[ObjectiveFunction] = None):
+        self.config = config
+        self.iter_ = 0
+        self.models: List[Tree] = []  # num_iter * num_class, class-major rows
+        self.best_iteration = -1
+        self.best_score: Dict[Tuple[int, str], float] = {}
+        self.best_msg: Dict[int, str] = {}
+        self.num_init_iteration = 0
+        self.label_idx = 0
+        self.sigmoid = (config.sigmoid if config.objective == "binary" else -1.0)
+        if train_set is not None:
+            self._setup(train_set, objective)
+
+    # ------------------------------------------------------------------
+    def _setup(self, train_set: BinnedDataset, objective) -> None:
+        cfg = self.config
+        self.train_set = train_set
+        self.objective = objective or create_objective(cfg)
+        self.objective.init(train_set.metadata, train_set.num_data)
+        self.num_class = self.objective.num_tree_per_iteration
+        self.num_data = train_set.num_data
+        self.num_features = train_set.num_features
+        self.max_feature_idx = train_set.num_total_features - 1
+        self.feature_names = list(train_set.feature_names)
+
+        self.num_bin = jnp.asarray(train_set.num_bin_per_feature())
+        self.is_cat = jnp.asarray(train_set.is_categorical_per_feature())
+        self.max_bin = cfg.max_bin
+        self.grow_params = GrowParams(
+            num_leaves=cfg.num_leaves, max_bin=cfg.max_bin,
+            min_data_in_leaf=cfg.min_data_in_leaf,
+            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+            lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
+            min_gain_to_split=cfg.min_gain_to_split,
+            max_depth=cfg.max_depth)
+        self.shrinkage_rate = cfg.learning_rate
+
+        self.train_data = _DeviceData(train_set, self.num_class)
+        self.valid_data: List[_DeviceData] = []
+        self.valid_metrics: List[List[Metric]] = []
+        self.train_metrics: List[Metric] = []
+        for name in cfg.metric:
+            m = create_metric(name, cfg)
+            if m is not None:
+                m.init(train_set.metadata, train_set.num_data)
+                self.train_metrics.append(m)
+
+        self._bagging_rng = np.random.RandomState(cfg.bagging_seed)
+        self._feature_rng = np.random.RandomState(cfg.feature_fraction_seed)
+        self._row_weight = jnp.ones(self.num_data, jnp.float32)
+        self._grad_fn = jax.jit(self.objective.gradients)
+
+    def add_valid_dataset(self, valid_set: BinnedDataset) -> None:
+        """GBDT::AddValidDataset (gbdt.cpp:169-199)."""
+        dd = _DeviceData(valid_set, self.num_class)
+        # replay existing trees (continued training)
+        for i, tree in enumerate(self.models):
+            cls = i % self.num_class
+            self._add_host_tree_to(dd, tree, cls)
+        self.valid_data.append(dd)
+        metrics = []
+        for name in self.config.metric:
+            m = create_metric(name, self.config)
+            if m is not None:
+                m.init(valid_set.metadata, valid_set.num_data)
+                metrics.append(m)
+        self.valid_metrics.append(metrics)
+
+    # ------------------------------------------------------------------
+    def _bagging_mask(self, iter_: int) -> jax.Array:
+        """Bagging (gbdt.cpp:201-280): pick bagging_fraction*N rows without
+        replacement every bagging_freq iterations."""
+        cfg = self.config
+        if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
+            return jnp.ones(self.num_data, jnp.float32)
+        if iter_ % cfg.bagging_freq == 0:
+            bag_cnt = int(cfg.bagging_fraction * self.num_data)
+            idx = self._bagging_rng.choice(self.num_data, bag_cnt,
+                                           replace=False)
+            mask = np.zeros(self.num_data, np.float32)
+            mask[idx] = 1.0
+            self._row_weight = jnp.asarray(mask)
+        return self._row_weight
+
+    def _feature_mask(self) -> jax.Array:
+        """feature_fraction sampling per tree (serial_tree_learner.cpp:226+)."""
+        frac = self.config.feature_fraction
+        if frac >= 1.0:
+            return jnp.ones(self.num_features, bool)
+        used = max(1, int(self.num_features * frac))
+        idx = self._feature_rng.choice(self.num_features, used, replace=False)
+        mask = np.zeros(self.num_features, bool)
+        mask[idx] = True
+        return jnp.asarray(mask)
+
+    # ------------------------------------------------------------------
+    def _gradients(self) -> Tuple[jax.Array, jax.Array]:
+        return self._grad_fn(self.train_data.score)
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        """One boosting round (gbdt.cpp:295-382).  Returns True when training
+        should stop (no more splits possible on every class)."""
+        if grad is None or hess is None:
+            grad, hess = self._gradients()
+        else:
+            grad = jnp.asarray(grad, jnp.float32).reshape(self.num_class, -1)
+            hess = jnp.asarray(hess, jnp.float32).reshape(self.num_class, -1)
+        row_weight = self._bagging_mask(self.iter_)
+        could_split_any = False
+        for cls in range(self.num_class):
+            feat_mask = self._feature_mask()
+            tree_arrays, leaf_id, delta = grow_tree(
+                self.train_data.bins, self.num_bin, self.is_cat, feat_mask,
+                grad[cls], hess[cls], row_weight,
+                jnp.float32(self.shrinkage_rate), self.grow_params)
+            self.train_data.score = self.train_data.score.at[cls].add(delta)
+            host_tree = Tree.from_arrays(
+                tree_arrays, self.train_set.mappers,
+                self.train_set.used_feature_map,
+                self.shrinkage_rate)
+            if host_tree.num_leaves > 1:
+                could_split_any = True
+            self.models.append(host_tree)
+            for dd in self.valid_data:
+                self._add_device_tree_to(dd, tree_arrays, cls)
+        self.iter_ += 1
+        if not could_split_any:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements.")
+            # drop the useless constant trees of this iteration
+            for _ in range(self.num_class):
+                self.models.pop()
+            self.iter_ -= 1
+            return True
+        return False
+
+    def rollback_one_iter(self) -> None:
+        """GBDT::RollbackOneIter (gbdt.cpp:384-402)."""
+        if self.iter_ <= 0:
+            return
+        for cls in reversed(range(self.num_class)):
+            tree = self.models.pop()
+            if tree.num_leaves > 1:
+                neg = _negate_tree(tree)
+                self._add_host_tree_to(self.train_data, neg, cls)
+                for dd in self.valid_data:
+                    self._add_host_tree_to(dd, neg, cls)
+        self.iter_ -= 1
+
+    # ------------------------------------------------------------------
+    def _add_device_tree_to(self, dd: _DeviceData, tree_arrays, cls: int):
+        delta, _ = predict_binned_tree(
+            tree_arrays.split_feature, tree_arrays.split_bin,
+            self.is_cat[jnp.maximum(tree_arrays.split_feature, 0)],
+            tree_arrays.left_child, tree_arrays.right_child,
+            tree_arrays.leaf_value, dd.bins,
+            self.grow_params.num_leaves)
+        dd.score = dd.score.at[cls].add(delta)
+
+    def _add_host_tree_to(self, dd: _DeviceData, tree: Tree, cls: int):
+        if tree.num_leaves <= 1:
+            dd.score = dd.score.at[cls].add(float(tree.leaf_value[0])
+                                            if tree.num_leaves else 0.0)
+            return
+        inner = np.asarray([self.train_set.real_to_inner[f]
+                            for f in tree.split_feature], np.int32)
+        delta, _ = predict_binned_tree(
+            jnp.asarray(inner), jnp.asarray(tree.threshold_in_bin),
+            jnp.asarray(tree.decision_type == 1),
+            jnp.asarray(tree.left_child), jnp.asarray(tree.right_child),
+            jnp.asarray(tree.leaf_value, jnp.float32), dd.bins,
+            int(tree.num_leaves))
+        dd.score = dd.score.at[cls].add(delta)
+
+    # ------------------------------------------------------------------
+    def eval_and_check_early_stopping(self) -> bool:
+        """Metric evaluation + early-stop bookkeeping (gbdt.cpp:404-509).
+        Returns True to stop training."""
+        cfg = self.config
+        out_lines = []
+        if cfg.is_training_metric and self.train_metrics:
+            score = np.asarray(self.train_data.score, np.float64)
+            for m in self.train_metrics:
+                for name, v in zip(m.names, m.eval(score)):
+                    out_lines.append(f"Iteration:{self.iter_}, training {name} : {v:g}")
+        stop = False
+        for vi, (dd, metrics) in enumerate(zip(self.valid_data,
+                                               self.valid_metrics)):
+            score = np.asarray(dd.score, np.float64)
+            for mi, m in enumerate(metrics):
+                values = m.eval(score)
+                for name, v in zip(m.names, values):
+                    out_lines.append(
+                        f"Iteration:{self.iter_}, valid_{vi + 1} {name} : {v:g}")
+                key = (vi, m.names[0])
+                cur = m.factor_to_bigger_better * values[0]
+                if key not in self.best_score or cur > self.best_score[key]:
+                    self.best_score[key] = cur
+                    if mi == 0:
+                        self.best_iteration = self.iter_
+                        self.best_msg[vi] = "\n".join(out_lines)
+                elif cfg.early_stopping_round > 0 and mi == 0:
+                    if self.iter_ - self.best_iteration >= cfg.early_stopping_round:
+                        log.info("Early stopping at iteration %d, best iteration %d",
+                                 self.iter_, self.best_iteration)
+                        stop = True
+        if out_lines and (self.iter_ % max(cfg.output_freq, 1) == 0):
+            for line in out_lines:
+                log.info("%s", line)
+        return stop
+
+    def eval_metrics(self) -> Dict[str, Dict[str, float]]:
+        """All current metric values, for callbacks/evals_result."""
+        out: Dict[str, Dict[str, float]] = {}
+        if self.train_metrics:
+            score = np.asarray(self.train_data.score, np.float64)
+            out["training"] = {}
+            for m in self.train_metrics:
+                for name, v in zip(m.names, m.eval(score)):
+                    out["training"][name] = v
+        for vi, (dd, metrics) in enumerate(zip(self.valid_data,
+                                               self.valid_metrics)):
+            key = f"valid_{vi + 1}"
+            score = np.asarray(dd.score, np.float64)
+            out[key] = {}
+            for m in metrics:
+                for name, v in zip(m.names, m.eval(score)):
+                    out[key][name] = v
+        return out
+
+    # ------------------------------------------------------------------
+    def train(self, num_iterations: Optional[int] = None) -> None:
+        """Application::Train equivalent loop (application.cpp:224-240)."""
+        n = num_iterations or self.config.num_iterations
+        for it in range(n):
+            stop = self.train_one_iter()
+            if not stop and (self.valid_data or self.config.is_training_metric):
+                stop = self.eval_and_check_early_stopping() or stop
+            if stop:
+                break
+
+    # ------------------------------------------------------------------
+    # Prediction (host entry: raw feature values)
+    def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        """[K, n] raw scores (GBDT::PredictRaw, gbdt.cpp:791-798)."""
+        X = np.asarray(X, np.float64)
+        n_models = len(self.models)
+        if num_iteration > 0:
+            n_models = min(n_models, num_iteration * self.num_class)
+        out = np.zeros((self.num_class, X.shape[0]), np.float64)
+        for i in range(n_models):
+            out[i % self.num_class] += self.models[i].predict(X)
+        return out
+
+    def predict(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        """With sigmoid/softmax transform (gbdt.cpp:799-815)."""
+        raw = self.predict_raw(X, num_iteration)
+        return np.asarray(self.objective.convert_output(raw)) \
+            if hasattr(self, "objective") and self.objective is not None else raw
+
+    def predict_leaf_index(self, X: np.ndarray,
+                           num_iteration: int = -1) -> np.ndarray:
+        X = np.asarray(X, np.float64)
+        n_models = len(self.models)
+        if num_iteration > 0:
+            n_models = min(n_models, num_iteration * self.num_class)
+        return np.stack([self.models[i].predict_leaf_index(X)
+                         for i in range(n_models)], axis=1)
+
+    # ------------------------------------------------------------------
+    # Model serialization (gbdt.cpp:625-760)
+    def save_model_to_string(self, num_iteration: int = -1) -> str:
+        buf = io.StringIO()
+        buf.write(self.submodel_name + "\n")
+        buf.write(f"num_class={self.num_class}\n")
+        buf.write(f"label_index={self.label_idx}\n")
+        buf.write(f"max_feature_idx={self.max_feature_idx}\n")
+        if getattr(self, "objective", None) is not None:
+            buf.write(f"objective={self.objective.name}\n")
+        buf.write(f"sigmoid={self.sigmoid:g}\n")
+        buf.write("feature_names=" + " ".join(self.feature_names) + "\n")
+        buf.write("feature_infos=" + " ".join(
+            self.train_set.feature_infos() if hasattr(self, "train_set")
+            else getattr(self, "feature_infos_", [])) + "\n")
+        buf.write("\n")
+        n_models = len(self.models)
+        if num_iteration > 0:
+            n_models = min(n_models, num_iteration * self.num_class)
+        for i in range(n_models):
+            buf.write(f"Tree={i}\n")
+            buf.write(self.models[i].to_string())
+            buf.write("\n")
+        buf.write("\nfeature importances:\n")
+        for name, cnt in self.feature_importance():
+            buf.write(f"{name}={cnt}\n")
+        return buf.getvalue()
+
+    def save_model_to_file(self, path: str, num_iteration: int = -1) -> None:
+        with open(path, "w") as fh:
+            fh.write(self.save_model_to_string(num_iteration))
+
+    def feature_importance(self):
+        """Split-count importance (gbdt.cpp:765-789)."""
+        counts = np.zeros(self.max_feature_idx + 1, np.int64)
+        for tree in self.models:
+            for f in tree.split_feature[:tree.num_leaves - 1]:
+                counts[f] += 1
+        pairs = [(self.feature_names[f], int(counts[f]))
+                 for f in range(len(counts)) if counts[f] > 0]
+        pairs.sort(key=lambda kv: -kv[1])
+        return pairs
+
+    def load_model_from_string(self, text: str) -> None:
+        """gbdt.cpp:679-760."""
+        lines = text.splitlines()
+        kv: Dict[str, str] = {}
+        for ln in lines:
+            if ln.startswith("Tree="):
+                break
+            if "=" in ln:
+                k, v = ln.split("=", 1)
+                kv[k.strip()] = v.strip()
+        if "num_class" not in kv:
+            log.fatal("Model file doesn't specify the number of classes")
+        self.num_class = int(kv["num_class"])
+        self.label_idx = int(kv.get("label_index", 0))
+        self.max_feature_idx = int(kv.get("max_feature_idx", 0))
+        self.sigmoid = float(kv.get("sigmoid", -1.0))
+        self.feature_names = kv.get("feature_names", "").split()
+        self.feature_infos_ = kv.get("feature_infos", "").split()
+        self.objective_name = kv.get("objective", "")
+        # parse tree blocks
+        self.models = []
+        blocks = text.split("Tree=")
+        for blk in blocks[1:]:
+            body = blk.split("\n", 1)[1]
+            stop_at = body.find("\nfeature importances")
+            if stop_at >= 0:
+                body = body[:stop_at]
+            self.models.append(Tree.from_string(body))
+        self.num_init_iteration = len(self.models) // max(self.num_class, 1)
+        self.iter_ = self.num_init_iteration
+        if not hasattr(self, "objective") or self.objective is None:
+            self.objective = _objective_for_prediction(
+                self.objective_name, self.sigmoid, self.num_class)
+
+    def num_trees(self) -> int:
+        return len(self.models)
+
+
+def _negate_tree(tree: Tree) -> Tree:
+    import copy
+    neg = copy.deepcopy(tree)
+    neg.leaf_value = -neg.leaf_value
+    return neg
+
+
+class _PredictionObjective(ObjectiveFunction):
+    """Stand-in objective for loaded models (transform only)."""
+
+    def __init__(self, name, sigmoid, num_class):
+        self.name = name or "none"
+        self.sigmoid = sigmoid
+        self.num_class = num_class
+        self.num_tree_per_iteration = num_class
+
+    def convert_output(self, score):
+        if self.num_class > 1:
+            e = np.exp(score - score.max(axis=0, keepdims=True))
+            return e / e.sum(axis=0, keepdims=True)
+        if self.sigmoid > 0:
+            return 1.0 / (1.0 + np.exp(-self.sigmoid * score))
+        return score
+
+
+def _objective_for_prediction(name, sigmoid, num_class):
+    return _PredictionObjective(name, sigmoid, num_class)

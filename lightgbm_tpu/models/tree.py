@@ -1,0 +1,249 @@
+"""Host-side decision tree model: flat arrays + reference-compatible text
+serialization.
+
+Mirrors the reference Tree (include/LightGBM/tree.h:17-194, src/io/tree.cpp):
+flat left/right child arrays with leaves encoded as ``~leaf_index``,
+numerical decision ``value <= threshold`` (decision_type 0) and categorical
+``int(value) == int(threshold)`` (decision_type 1), and the exact
+``Tree=...`` text block format (tree.cpp:295-338) so models interchange with
+the reference CLI.
+
+Prediction on raw values is implemented by binning the input with the
+training BinMappers and walking with integer bin comparisons — exactly
+equivalent to the reference's double comparison because
+``value <= bin_upper_bound[t]  <=>  value_to_bin(value) <= t``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def _fmt(x: float) -> str:
+    """C++ ostream with setprecision(digits10+2) ~ %.17g, but trimmed."""
+    return f"{x:.17g}"
+
+
+def _fmt_arr(arr) -> str:
+    return " ".join(_fmt(float(v)) for v in arr)
+
+
+def _fmt_int_arr(arr) -> str:
+    return " ".join(str(int(v)) for v in arr)
+
+
+class Tree:
+    """A trained decision tree (host representation)."""
+
+    def __init__(self, num_leaves: int):
+        self.num_leaves = num_leaves
+        n = max(num_leaves - 1, 0)
+        self.split_feature_inner = np.zeros(n, dtype=np.int32)
+        self.split_feature = np.zeros(n, dtype=np.int32)  # real feature idx
+        self.split_gain = np.zeros(n, dtype=np.float64)
+        self.threshold_in_bin = np.zeros(n, dtype=np.int32)
+        self.threshold = np.zeros(n, dtype=np.float64)    # real-value threshold
+        self.decision_type = np.zeros(n, dtype=np.int8)
+        self.left_child = np.zeros(n, dtype=np.int32)
+        self.right_child = np.zeros(n, dtype=np.int32)
+        self.leaf_parent = np.zeros(num_leaves, dtype=np.int32)
+        self.leaf_value = np.zeros(num_leaves, dtype=np.float64)
+        self.leaf_count = np.zeros(num_leaves, dtype=np.int32)
+        self.internal_value = np.zeros(n, dtype=np.float64)
+        self.internal_count = np.zeros(n, dtype=np.int32)
+        self.shrinkage = 1.0
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_arrays(cls, tree_arrays, mappers, used_feature_map,
+                    learning_rate: float) -> "Tree":
+        """Build from device TreeArrays (ops/grow.py).  Leaf values arrive
+        already shrunk; ``shrinkage`` records the rate like Tree::Shrinkage."""
+        num_leaves = int(tree_arrays.num_leaves)
+        t = cls(num_leaves)
+        n = num_leaves - 1
+        sf = np.asarray(tree_arrays.split_feature)[:n]
+        sb = np.asarray(tree_arrays.split_bin)[:n]
+        t.split_feature_inner = sf.astype(np.int32)
+        t.split_feature = np.asarray(
+            [used_feature_map[f] for f in sf], dtype=np.int32)
+        t.split_gain = np.asarray(tree_arrays.split_gain, dtype=np.float64)[:n]
+        t.threshold_in_bin = sb.astype(np.int32)
+        t.threshold = np.asarray(
+            [mappers[f].bin_to_value(b) for f, b in zip(sf, sb)],
+            dtype=np.float64)
+        t.decision_type = np.asarray(
+            [1 if mappers[f].bin_type == 1 else 0 for f in sf], dtype=np.int8)
+        t.left_child = np.asarray(tree_arrays.left_child, dtype=np.int32)[:n]
+        t.right_child = np.asarray(tree_arrays.right_child, dtype=np.int32)[:n]
+        t.leaf_parent = np.asarray(tree_arrays.leaf_parent,
+                                   dtype=np.int32)[:num_leaves]
+        t.leaf_value = np.asarray(tree_arrays.leaf_value,
+                                  dtype=np.float64)[:num_leaves]
+        t.leaf_count = np.asarray(tree_arrays.leaf_count,
+                                  dtype=np.int32)[:num_leaves]
+        t.internal_value = np.asarray(tree_arrays.internal_value,
+                                      dtype=np.float64)[:n]
+        t.internal_count = np.asarray(tree_arrays.internal_count,
+                                      dtype=np.int32)[:n]
+        t.shrinkage = learning_rate
+        return t
+
+    # ------------------------------------------------------------------
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Raw-value prediction, vectorized node walk (tree.h:197-227)."""
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.full(n, self.leaf_value[0] if self.num_leaves else 0.0)
+        node = np.zeros(n, dtype=np.int32)
+        active = np.ones(n, dtype=bool)
+        out = np.zeros(n, dtype=np.float64)
+        for _ in range(self.num_leaves):  # max depth bound
+            if not active.any():
+                break
+            idx = node[active]
+            fv = X[active, self.split_feature[idx]]
+            th = self.threshold[idx]
+            is_cat = self.decision_type[idx] == 1
+            go_left = np.where(is_cat, fv.astype(np.int64) == th.astype(np.int64),
+                               fv <= th)
+            nxt = np.where(go_left, self.left_child[idx], self.right_child[idx])
+            node_active = node.copy()
+            node_active[active] = nxt
+            node = node_active
+            arrived = active & (node < 0)
+            out[arrived] = self.leaf_value[~node[arrived]]
+            active = active & (node >= 0)
+        return out
+
+    def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        node = np.zeros(n, dtype=np.int32)
+        for _ in range(self.num_leaves):
+            if (node < 0).all():
+                break
+            live = node >= 0
+            idx = node[live]
+            fv = X[live, self.split_feature[idx]]
+            th = self.threshold[idx]
+            is_cat = self.decision_type[idx] == 1
+            go_left = np.where(is_cat, fv.astype(np.int64) == th.astype(np.int64),
+                               fv <= th)
+            node[live] = np.where(go_left, self.left_child[idx],
+                                  self.right_child[idx])
+        return (~node).astype(np.int32)
+
+    def max_depth(self) -> int:
+        if self.num_leaves <= 1:
+            return 0
+        depth = np.zeros(self.num_leaves - 1, dtype=np.int32)
+        best = 1
+        for node in range(self.num_leaves - 1):
+            for child in (self.left_child[node], self.right_child[node]):
+                if child >= 0:
+                    depth[child] = depth[node] + 1
+                    best = max(best, depth[child] + 1)
+                else:
+                    best = max(best, depth[node] + 1)
+        return best
+
+    # ------------------------------------------------------------------
+    def to_string(self) -> str:
+        """Tree::ToString (tree.cpp:295-324) byte-compatible layout."""
+        n = self.num_leaves - 1
+        lines = [
+            f"num_leaves={self.num_leaves}",
+            f"split_feature={_fmt_int_arr(self.split_feature[:n])}",
+            f"split_gain={_fmt_arr(self.split_gain[:n])}",
+            f"threshold={_fmt_arr(self.threshold[:n])}",
+            f"decision_type={_fmt_int_arr(self.decision_type[:n])}",
+            f"left_child={_fmt_int_arr(self.left_child[:n])}",
+            f"right_child={_fmt_int_arr(self.right_child[:n])}",
+            f"leaf_parent={_fmt_int_arr(self.leaf_parent[:self.num_leaves])}",
+            f"leaf_value={_fmt_arr(self.leaf_value[:self.num_leaves])}",
+            f"leaf_count={_fmt_int_arr(self.leaf_count[:self.num_leaves])}",
+            f"internal_value={_fmt_arr(self.internal_value[:n])}",
+            f"internal_count={_fmt_int_arr(self.internal_count[:n])}",
+            f"shrinkage={_fmt(self.shrinkage)}",
+            "",
+        ]
+        return "\n".join(lines) + "\n"
+
+    @classmethod
+    def from_string(cls, text: str) -> "Tree":
+        """Tree(str) parser (tree.cpp:368-430)."""
+        kv: Dict[str, str] = {}
+        for line in text.splitlines():
+            if "=" in line:
+                k, v = line.split("=", 1)
+                k, v = k.strip(), v.strip()
+                if k and v:
+                    kv[k] = v
+        required = ("num_leaves", "split_feature", "split_gain", "threshold",
+                    "left_child", "right_child", "leaf_parent", "leaf_value",
+                    "internal_value", "internal_count", "leaf_count",
+                    "shrinkage", "decision_type")
+        missing = [k for k in required if k not in kv]
+        if missing and kv.get("num_leaves") != "1":
+            raise ValueError(f"Tree model string format error: missing {missing}")
+        num_leaves = int(kv["num_leaves"])
+        t = cls(num_leaves)
+
+        def ints(key, count):
+            if count <= 0 or key not in kv:
+                return np.zeros(max(count, 0), dtype=np.int32)
+            return np.asarray([int(float(x)) for x in kv[key].split()][:count],
+                              dtype=np.int32)
+
+        def floats(key, count):
+            if count <= 0 or key not in kv:
+                return np.zeros(max(count, 0), dtype=np.float64)
+            return np.asarray([float(x) for x in kv[key].split()][:count],
+                              dtype=np.float64)
+
+        n = num_leaves - 1
+        t.split_feature = ints("split_feature", n)
+        t.split_feature_inner = t.split_feature.copy()
+        t.split_gain = floats("split_gain", n)
+        t.threshold = floats("threshold", n)
+        t.decision_type = ints("decision_type", n).astype(np.int8)
+        t.left_child = ints("left_child", n)
+        t.right_child = ints("right_child", n)
+        t.leaf_parent = ints("leaf_parent", num_leaves)
+        t.leaf_value = floats("leaf_value", num_leaves)
+        t.leaf_count = ints("leaf_count", num_leaves)
+        t.internal_value = floats("internal_value", n)
+        t.internal_count = ints("internal_count", n)
+        t.shrinkage = float(kv["shrinkage"])
+        return t
+
+    def to_json(self) -> dict:
+        """Tree::ToJSON structure (tree.cpp:326-366)."""
+        def node_json(index: int):
+            if index >= 0:
+                return {
+                    "split_index": int(index),
+                    "split_feature": int(self.split_feature[index]),
+                    "split_gain": float(self.split_gain[index]),
+                    "threshold": float(self.threshold[index]),
+                    "decision_type": "no_greater" if self.decision_type[index] == 0 else "is",
+                    "internal_value": float(self.internal_value[index]),
+                    "internal_count": int(self.internal_count[index]),
+                    "left_child": node_json(int(self.left_child[index])),
+                    "right_child": node_json(int(self.right_child[index])),
+                }
+            leaf = ~index
+            return {
+                "leaf_index": int(leaf),
+                "leaf_parent": int(self.leaf_parent[leaf]),
+                "leaf_value": float(self.leaf_value[leaf]),
+                "leaf_count": int(self.leaf_count[leaf]),
+            }
+        return {"num_leaves": int(self.num_leaves),
+                "shrinkage": float(self.shrinkage),
+                "tree_structure": node_json(0) if self.num_leaves > 1 else {
+                    "leaf_value": float(self.leaf_value[0]) if self.num_leaves else 0.0}}

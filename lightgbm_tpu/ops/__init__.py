@@ -1,0 +1,2 @@
+from .grow import GrowParams, TreeArrays, grow_tree  # noqa: F401
+from .split import BestSplit, SplitParams, find_best_split, leaf_output  # noqa: F401

@@ -1,0 +1,310 @@
+"""Jitted leaf-wise (best-first) tree growth.
+
+The reference grows a tree with a data-dependent Python-style loop
+(SerialTreeLearner::Train, serial_tree_learner.cpp:167-224): pick the leaf
+with the best split, partition its rows, build child histograms, find child
+splits, repeat num_leaves-1 times, breaking early when no leaf has positive
+gain.  On TPU the whole loop runs inside one jitted ``lax.fori_loop`` with
+fixed trip count: the early break becomes a masked no-op (observationally
+identical because once no leaf can split, no new splits ever appear).
+
+Fixed-shape state replaces the reference's dynamic structures:
+  * DataPartition's shuffled index array (data_partition.hpp) -> a per-row
+    ``leaf_id`` vector updated with ``where``,
+  * the LRU histogram pool -> nothing: both children's histograms are built
+    in one masked scatter pass per split (see ops/histogram.py),
+  * SplitInfo per leaf -> struct-of-arrays over [num_leaves].
+
+Node/leaf indexing matches Tree::Split (tree.cpp:52-95): step k creates
+internal node k; the left child keeps the parent's leaf index, the right
+child becomes leaf k+1; children encoded as ~leaf in the child arrays.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .histogram import build_children_histograms, build_root_histogram
+from .split import (BestSplit, SplitParams, find_best_split, leaf_output,
+                    K_MIN_SCORE)
+
+
+class GrowParams(NamedTuple):
+    """Static tree-growth configuration."""
+    num_leaves: int = 31
+    max_bin: int = 255
+    min_data_in_leaf: int = 100
+    min_sum_hessian_in_leaf: float = 10.0
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    max_depth: int = -1
+
+    def split_params(self) -> SplitParams:
+        return SplitParams(self.min_data_in_leaf, self.min_sum_hessian_in_leaf,
+                           self.lambda_l1, self.lambda_l2,
+                           self.min_gain_to_split)
+
+
+class TreeArrays(NamedTuple):
+    """Flat tree tensors (device-side Tree, mirrors tree.h:17-194).
+
+    Leaf values are already scaled by learning_rate (Shrinkage applied at
+    the end of growth like GBDT::TrainOneIter, gbdt.cpp:357)."""
+    num_leaves: jax.Array          # scalar i32: leaves actually grown
+    split_feature: jax.Array       # [L-1] i32 inner feature index
+    split_bin: jax.Array           # [L-1] i32 bin threshold
+    split_gain: jax.Array          # [L-1] f32
+    left_child: jax.Array          # [L-1] i32 (~leaf or node)
+    right_child: jax.Array         # [L-1] i32
+    internal_value: jax.Array      # [L-1] f32 (unshrunk, like reference)
+    internal_count: jax.Array      # [L-1] i32
+    leaf_value: jax.Array          # [L] f32 (shrunk)
+    leaf_count: jax.Array          # [L] i32
+    leaf_parent: jax.Array         # [L] i32
+    leaf_depth: jax.Array          # [L] i32
+
+
+class _GrowState(NamedTuple):
+    leaf_id: jax.Array             # [N] i32
+    num_leaves: jax.Array          # scalar i32
+    stopped: jax.Array             # scalar bool
+    # per-leaf best-split SoA [L]
+    best_gain: jax.Array
+    best_feat: jax.Array
+    best_bin: jax.Array
+    best_left_g: jax.Array
+    best_left_h: jax.Array
+    best_left_c: jax.Array
+    # per-leaf totals [L]
+    total_g: jax.Array
+    total_h: jax.Array
+    total_c: jax.Array
+    cur_value: jax.Array           # [L] leaf output at creation (unshrunk)
+    leaf_parent: jax.Array         # [L]
+    leaf_depth: jax.Array          # [L]
+    # node arrays [L-1]
+    split_feature: jax.Array
+    split_bin: jax.Array
+    split_gain: jax.Array
+    left_child: jax.Array
+    right_child: jax.Array
+    internal_value: jax.Array
+    internal_count: jax.Array
+
+
+def _store_leaf_split(state: _GrowState, leaf, split: BestSplit) -> _GrowState:
+    return state._replace(
+        best_gain=state.best_gain.at[leaf].set(split.gain),
+        best_feat=state.best_feat.at[leaf].set(split.feature),
+        best_bin=state.best_bin.at[leaf].set(split.threshold),
+        best_left_g=state.best_left_g.at[leaf].set(split.left_sum_g),
+        best_left_h=state.best_left_h.at[leaf].set(split.left_sum_h),
+        best_left_c=state.best_left_c.at[leaf].set(split.left_count),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def grow_tree(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
+              learning_rate, params: GrowParams):
+    """Grow one tree.  All inputs are device arrays.
+
+    Args:
+      bins: [F, N] feature-major bin codes.
+      num_bin: [F] i32; is_cat: [F] bool; feat_mask: [F] bool.
+      grad, hess: [N] f32 raw gradients/hessians.
+      row_weight: [N] f32 bagging/GOSS weight (0 excludes a row from
+        training; weights also scale grad/hess like the reference's
+        gradient amplification).
+    Returns (TreeArrays, leaf_id [N] i32, output_delta [N] f32) where
+      output_delta = shrunk leaf value per row (the train-score update,
+      serial_tree_learner AddPredictionToScore semantics).
+    """
+    L = params.num_leaves
+    B = params.max_bin
+    F, N = bins.shape
+    sp = params.split_params()
+
+    g = grad * row_weight
+    h = hess * row_weight
+
+    root_g = jnp.sum(g)
+    root_h = jnp.sum(h)
+    root_c = jnp.sum(row_weight)
+
+    hist_root = build_root_histogram(bins, g, h, row_weight, B)
+    root_split = find_best_split(hist_root, root_g, root_h, root_c,
+                                 num_bin, is_cat, feat_mask,
+                                 jnp.asarray(True), sp)
+
+    neg_inf = jnp.full((L,), K_MIN_SCORE, dtype=jnp.float32)
+    state = _GrowState(
+        leaf_id=jnp.zeros((N,), dtype=jnp.int32),
+        num_leaves=jnp.asarray(1, jnp.int32),
+        stopped=jnp.asarray(False),
+        best_gain=neg_inf.at[0].set(root_split.gain),
+        best_feat=jnp.zeros((L,), jnp.int32).at[0].set(root_split.feature),
+        best_bin=jnp.zeros((L,), jnp.int32).at[0].set(root_split.threshold),
+        best_left_g=jnp.zeros((L,), jnp.float32).at[0].set(root_split.left_sum_g),
+        best_left_h=jnp.zeros((L,), jnp.float32).at[0].set(root_split.left_sum_h),
+        best_left_c=jnp.zeros((L,), jnp.float32).at[0].set(root_split.left_count),
+        total_g=jnp.zeros((L,), jnp.float32).at[0].set(root_g),
+        total_h=jnp.zeros((L,), jnp.float32).at[0].set(root_h),
+        total_c=jnp.zeros((L,), jnp.float32).at[0].set(root_c),
+        cur_value=jnp.zeros((L,), jnp.float32),
+        leaf_parent=jnp.full((L,), -1, jnp.int32),
+        leaf_depth=jnp.zeros((L,), jnp.int32),
+        split_feature=jnp.full((L - 1,), -1, jnp.int32),
+        split_bin=jnp.zeros((L - 1,), jnp.int32),
+        split_gain=jnp.zeros((L - 1,), jnp.float32),
+        left_child=jnp.zeros((L - 1,), jnp.int32),
+        right_child=jnp.zeros((L - 1,), jnp.int32),
+        internal_value=jnp.zeros((L - 1,), jnp.float32),
+        internal_count=jnp.zeros((L - 1,), jnp.int32),
+    )
+
+    def step(k, state: _GrowState) -> _GrowState:
+        # Best leaf by gain; ties -> first (smallest leaf idx), matching
+        # ArrayArgs::ArgMax over SplitInfo (serial_tree_learner.cpp:204).
+        best_leaf = jnp.argmax(state.best_gain).astype(jnp.int32)
+        gain = state.best_gain[best_leaf]
+        do_split = jnp.logical_and(~state.stopped, gain > 0.0)
+        stopped = ~do_split
+
+        feat = state.best_feat[best_leaf]
+        tbin = state.best_bin[best_leaf]
+        right_leaf = state.num_leaves  # new leaf index (tree.cpp:89)
+
+        # --- partition: rows of best_leaf with bin > t (numerical) or
+        # bin != t (categorical) move to the right child -------------------
+        fbin = jnp.take(bins, jnp.maximum(feat, 0), axis=0).astype(jnp.int32)
+        go_right = jnp.where(is_cat[jnp.maximum(feat, 0)],
+                             fbin != tbin, fbin > tbin)
+        in_leaf = state.leaf_id == best_leaf
+        new_leaf_id = jnp.where(do_split & in_leaf & go_right,
+                                right_leaf, state.leaf_id)
+
+        # --- split sums ---------------------------------------------------
+        parent_g = state.total_g[best_leaf]
+        parent_h = state.total_h[best_leaf]
+        parent_c = state.total_c[best_leaf]
+        left_g = state.best_left_g[best_leaf]
+        left_h = state.best_left_h[best_leaf]
+        left_c = state.best_left_c[best_leaf]
+        right_g = parent_g - left_g
+        right_h = parent_h - left_h
+        right_c = parent_c - left_c
+        left_val = leaf_output(left_g, left_h, sp.lambda_l1, sp.lambda_l2)
+        right_val = leaf_output(right_g, right_h, sp.lambda_l1, sp.lambda_l2)
+
+        # --- tree structure updates (Tree::Split, tree.cpp:52-95) ---------
+        node = k  # node index == split step while not stopped
+        parent_node = state.leaf_parent[best_leaf]
+        p_safe = jnp.maximum(parent_node, 0)
+        was_left = state.left_child[p_safe] == ~best_leaf
+        upd_parent = do_split & (parent_node >= 0)
+        left_child = state.left_child.at[p_safe].set(
+            jnp.where(upd_parent & was_left, node, state.left_child[p_safe]))
+        right_child = state.right_child.at[p_safe].set(
+            jnp.where(upd_parent & ~was_left, node, state.right_child[p_safe]))
+
+        def upd(arr, value):
+            return arr.at[node].set(jnp.where(do_split, value, arr[node]))
+
+        depth = state.leaf_depth[best_leaf]
+        new_state = state._replace(
+            leaf_id=new_leaf_id,
+            num_leaves=state.num_leaves + jnp.where(do_split, 1, 0),
+            stopped=stopped,
+            split_feature=upd(state.split_feature, feat),
+            split_bin=upd(state.split_bin, tbin),
+            split_gain=upd(state.split_gain, gain),
+            left_child=upd(left_child, ~best_leaf),
+            right_child=upd(right_child, ~right_leaf),
+            internal_value=upd(state.internal_value,
+                               state.cur_value[best_leaf]),
+            internal_count=upd(state.internal_count,
+                               parent_c.astype(jnp.int32)),
+            total_g=state.total_g.at[best_leaf].set(
+                jnp.where(do_split, left_g, parent_g))
+                .at[right_leaf].set(jnp.where(do_split, right_g, 0.0)),
+            total_h=state.total_h.at[best_leaf].set(
+                jnp.where(do_split, left_h, parent_h))
+                .at[right_leaf].set(jnp.where(do_split, right_h, 0.0)),
+            total_c=state.total_c.at[best_leaf].set(
+                jnp.where(do_split, left_c, parent_c))
+                .at[right_leaf].set(jnp.where(do_split, right_c, 0.0)),
+            cur_value=state.cur_value.at[best_leaf].set(
+                jnp.where(do_split, left_val, state.cur_value[best_leaf]))
+                .at[right_leaf].set(jnp.where(do_split, right_val, 0.0)),
+            leaf_parent=state.leaf_parent.at[best_leaf].set(
+                jnp.where(do_split, node, parent_node))
+                .at[right_leaf].set(jnp.where(do_split, node, -1)),
+            leaf_depth=state.leaf_depth.at[best_leaf].set(
+                jnp.where(do_split, depth + 1, depth))
+                .at[right_leaf].set(jnp.where(do_split, depth + 1, 0)),
+        )
+
+        # --- child histograms + child best splits -------------------------
+        hists = build_children_histograms(
+            bins, g, h, row_weight, new_state.leaf_id, best_leaf, right_leaf, B)
+        child_depth_ok = jnp.logical_or(params.max_depth <= 0,
+                                        depth + 1 < params.max_depth)
+        totals_g = jnp.stack([left_g, right_g])
+        totals_h = jnp.stack([left_h, right_h])
+        totals_c = jnp.stack([left_c, right_c])
+        can = jnp.stack([do_split & child_depth_ok] * 2)
+        child_split = find_best_split(hists, totals_g, totals_h, totals_c,
+                                      num_bin, is_cat, feat_mask, can, sp)
+
+        # Invalidate the split leaf's old record, then store children.
+        new_state = new_state._replace(
+            best_gain=new_state.best_gain.at[best_leaf].set(
+                jnp.where(do_split, K_MIN_SCORE, new_state.best_gain[best_leaf])))
+        left_rec = jax.tree.map(lambda a: a[0], child_split)
+        right_rec = jax.tree.map(lambda a: a[1], child_split)
+        store_left = jax.tree.map(
+            lambda cur, new: jnp.where(do_split, new, cur),
+            BestSplit(new_state.best_gain[best_leaf],
+                      new_state.best_feat[best_leaf],
+                      new_state.best_bin[best_leaf],
+                      new_state.best_left_g[best_leaf],
+                      new_state.best_left_h[best_leaf],
+                      new_state.best_left_c[best_leaf]),
+            left_rec)
+        new_state = _store_leaf_split(new_state, best_leaf, store_left)
+        store_right = jax.tree.map(
+            lambda cur, new: jnp.where(do_split, new, cur),
+            BestSplit(new_state.best_gain[right_leaf],
+                      new_state.best_feat[right_leaf],
+                      new_state.best_bin[right_leaf],
+                      new_state.best_left_g[right_leaf],
+                      new_state.best_left_h[right_leaf],
+                      new_state.best_left_c[right_leaf]),
+            right_rec)
+        new_state = _store_leaf_split(new_state, right_leaf, store_right)
+        return new_state
+
+    state = jax.lax.fori_loop(0, L - 1, step, state)
+
+    shrunk = state.cur_value * learning_rate
+    tree = TreeArrays(
+        num_leaves=state.num_leaves,
+        split_feature=state.split_feature,
+        split_bin=state.split_bin,
+        split_gain=state.split_gain,
+        left_child=state.left_child,
+        right_child=state.right_child,
+        internal_value=state.internal_value,
+        internal_count=state.internal_count,
+        leaf_value=shrunk,
+        leaf_count=state.total_c.astype(jnp.int32),
+        leaf_parent=state.leaf_parent,
+        leaf_depth=state.leaf_depth,
+    )
+    output_delta = shrunk[state.leaf_id]
+    return tree, state.leaf_id, output_delta

@@ -1,0 +1,89 @@
+"""Vectorized tree traversal on binned data.
+
+Replaces the reference's per-row pointer walk (tree.h:197-227,
+Tree::AddPredictionToScore tree.cpp:102-160) with a data-parallel absorbing
+node walk: every row advances one level per step; rows that reach a leaf
+(negative child code) stay put.  Comparisons are integer bin comparisons,
+exactly equivalent to raw-value comparisons because thresholds are bin
+upper bounds (see models/tree.py docstring).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def predict_binned_tree(split_feature, split_bin, is_cat_node, left_child,
+                        right_child, leaf_value, bins, max_steps: int):
+    """Predict one tree on binned rows.
+
+    Args:
+      split_feature: [L-1] i32; split_bin: [L-1] i32; is_cat_node: [L-1] bool.
+      left_child/right_child: [L-1] i32 (~leaf or node index).
+      leaf_value: [L] f32.
+      bins: [F, N] bin codes.
+      max_steps: static depth bound (num_leaves is always enough).
+    Returns ([N] f32 leaf values, [N] i32 leaf indices).
+    """
+    N = bins.shape[1]
+
+    def step(_, node):
+        live = node >= 0
+        idx = jnp.maximum(node, 0)
+        feat = split_feature[idx]
+        fbin = jnp.take_along_axis(bins, feat[None, :],
+                                   axis=0)[0].astype(jnp.int32)
+        tbin = split_bin[idx]
+        go_left = jnp.where(is_cat_node[idx], fbin == tbin, fbin <= tbin)
+        nxt = jnp.where(go_left, left_child[idx], right_child[idx])
+        return jnp.where(live, nxt, node)
+
+    node0 = jnp.zeros(N, dtype=jnp.int32)
+    # a 1-leaf tree has no nodes: every row is leaf 0
+    has_split = leaf_value.shape[0] > 1 and split_feature.shape[0] > 0
+    if not has_split:
+        leaf = node0
+    else:
+        node = jax.lax.fori_loop(0, max_steps, step, node0)
+        leaf = jnp.where(node < 0, ~node, 0)
+    return leaf_value[leaf], leaf
+
+
+@functools.partial(jax.jit, static_argnames=("max_steps",))
+def predict_binned_forest(split_feature, split_bin, is_cat_node, left_child,
+                          right_child, leaf_value, bins, max_steps: int):
+    """Sum of tree predictions.
+
+    Tree arrays carry a leading [T] axis.  Returns [T_groups?]: here the sum
+    over all T trees, [N] f32.  For multiclass, call per class with that
+    class's tree stack.
+    """
+    def body(acc, tree):
+        sf, sb, ic, lc, rc, lv = tree
+        val, _ = predict_binned_tree(sf, sb, ic, lc, rc, lv, bins, max_steps)
+        return acc + val, None
+
+    N = bins.shape[1]
+    init = jnp.zeros(N, dtype=jnp.float32)
+    out, _ = jax.lax.scan(body, init, (split_feature, split_bin, is_cat_node,
+                                       left_child, right_child, leaf_value))
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("max_steps",))
+def predict_leaf_indices_forest(split_feature, split_bin, is_cat_node,
+                                left_child, right_child, leaf_value, bins,
+                                max_steps: int):
+    """[T, N] i32 leaf index per tree (PredictLeafIndex, gbdt.cpp:817-826)."""
+    def body(_, tree):
+        sf, sb, ic, lc, rc, lv = tree
+        _, leaf = predict_binned_tree(sf, sb, ic, lc, rc, lv, bins, max_steps)
+        return None, leaf
+
+    _, leaves = jax.lax.scan(body, None,
+                             (split_feature, split_bin, is_cat_node,
+                              left_child, right_child, leaf_value))
+    return leaves

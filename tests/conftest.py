@@ -1,11 +1,20 @@
 """Test config: force an 8-virtual-device CPU platform so data/feature/voting
-parallel paths are testable without a TPU pod (SURVEY.md §4)."""
+parallel paths are testable without a TPU pod (SURVEY.md §4).
+
+Note: this environment force-registers a TPU platform plugin ("axon") via
+sitecustomize and presets JAX_PLATFORMS, so a plain env-var setdefault is not
+enough — override the env var AND the live config before any test imports jax.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

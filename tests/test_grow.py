@@ -1,0 +1,242 @@
+"""Tree-grower correctness: against a brute-force host-side oracle that
+re-states the reference's leaf-wise algorithm (histogram + right-to-left
+scan + best-leaf argmax) in plain NumPy."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops.grow import GrowParams, grow_tree
+from lightgbm_tpu.ops.split import SplitParams, find_best_split
+from lightgbm_tpu.ops.histogram import build_root_histogram, histogram_onehot
+
+
+def _np_hist(bins, g, h, w, B):
+    F, N = bins.shape
+    out = np.zeros((F, B, 3))
+    for f in range(F):
+        for i in range(N):
+            b = bins[f, i]
+            out[f, b, 0] += g[i]
+            out[f, b, 1] += h[i]
+            out[f, b, 2] += w[i]
+    return out
+
+
+def _np_best_split(hist, tg, th, tc, num_bin, is_cat, p: SplitParams):
+    """Reference scan transcription (feature_histogram.hpp:75-187)."""
+    F, B, _ = hist.shape
+    best = dict(gain=-np.inf, feat=-1, t=-1, lg=0.0, lh=0.0, lc=0.0)
+    gain_shift = _gain(tg, th, p)
+    for f in range(F):
+        nb = num_bin[f]
+        if nb <= 1:
+            continue
+        if is_cat[f]:
+            cands = [(t, hist[f, t, 0], hist[f, t, 1], hist[f, t, 2])
+                     for t in range(nb - 1, -1, -1)]
+        else:
+            cum = np.cumsum(hist[f, :, :], axis=0)
+            cands = [(t, cum[t, 0], cum[t, 1], cum[t, 2])
+                     for t in range(nb - 2, -1, -1)]
+        for t, lg, lh, lc in cands:
+            rg, rh, rc = tg - lg, th - lh, tc - lc
+            if lc < p.min_data_in_leaf or rc < p.min_data_in_leaf:
+                continue
+            if lh < p.min_sum_hessian_in_leaf or rh < p.min_sum_hessian_in_leaf:
+                continue
+            cur = _gain(lg, lh, p) + _gain(rg, rh, p)
+            if cur <= gain_shift + p.min_gain_to_split:
+                continue
+            if cur > best["gain"] + gain_shift or (
+                    np.isclose(cur - gain_shift, best["gain"]) and f < best["feat"]):
+                # strictly-greater within a feature handled by scan order
+                if cur - gain_shift > best["gain"]:
+                    best = dict(gain=cur - gain_shift, feat=f, t=t,
+                                lg=lg, lh=lh, lc=lc)
+    return best
+
+
+def _gain(G, H, p):
+    reg = max(abs(G) - p.lambda_l1, 0.0)
+    return reg * reg / (H + p.lambda_l2)
+
+
+def _make_data(seed=0, n=400, f=5, B=16):
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, B, size=(f, n)).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = np.abs(rng.normal(size=n)).astype(np.float32) + 0.1
+    return bins, g, h
+
+
+def test_histogram_matches_numpy():
+    bins, g, h = _make_data()
+    w = np.ones_like(g)
+    hist = np.asarray(build_root_histogram(jnp.asarray(bins), jnp.asarray(g),
+                                           jnp.asarray(h), jnp.asarray(w), 16))
+    expected = _np_hist(bins, g, h, w, 16)
+    np.testing.assert_allclose(hist, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_histogram_onehot_matches_scatter():
+    bins, g, h = _make_data(n=1000)
+    w = np.ones_like(g)
+    a = np.asarray(build_root_histogram(jnp.asarray(bins), jnp.asarray(g),
+                                        jnp.asarray(h), jnp.asarray(w), 16))
+    b = np.asarray(histogram_onehot(jnp.asarray(bins), jnp.asarray(g),
+                                    jnp.asarray(h), jnp.asarray(w),
+                                    jnp.ones_like(jnp.asarray(g)), 16,
+                                    block=256))
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("l1,l2,min_data,min_hess", [
+    (0.0, 0.0, 5, 1e-3), (0.5, 1.0, 10, 0.5)])
+def test_find_best_split_matches_oracle(seed, l1, l2, min_data, min_hess):
+    bins, g, h = _make_data(seed=seed, B=16)
+    F = bins.shape[0]
+    w = np.ones_like(g)
+    hist = _np_hist(bins, g, h, w, 16)
+    p = SplitParams(min_data_in_leaf=min_data, min_sum_hessian_in_leaf=min_hess,
+                    lambda_l1=l1, lambda_l2=l2, min_gain_to_split=0.0)
+    num_bin = np.full(F, 16, np.int32)
+    is_cat = np.zeros(F, bool)
+    tg, th, tc = g.sum(), h.sum(), float(len(g))
+    oracle = _np_best_split(hist, tg, th, tc, num_bin, is_cat, p)
+
+    got = find_best_split(jnp.asarray(hist, jnp.float32), jnp.float32(tg),
+                          jnp.float32(th), jnp.float32(tc),
+                          jnp.asarray(num_bin), jnp.asarray(is_cat),
+                          jnp.ones(F, bool), jnp.asarray(True), p)
+    assert int(got.feature) == oracle["feat"]
+    assert int(got.threshold) == oracle["t"]
+    np.testing.assert_allclose(float(got.gain), oracle["gain"], rtol=1e-4)
+    np.testing.assert_allclose(float(got.left_count), oracle["lc"], rtol=1e-5)
+
+
+def test_find_best_split_categorical():
+    rng = np.random.RandomState(3)
+    n, B = 600, 8
+    bins = rng.randint(0, B, size=(1, n)).astype(np.int32)
+    # category 5 has clearly different gradient
+    g = np.where(bins[0] == 5, -2.0, 0.5).astype(np.float32) \
+        + rng.normal(scale=0.1, size=n).astype(np.float32)
+    h = np.ones(n, np.float32)
+    p = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3)
+    hist = _np_hist(bins, g, h, np.ones(n), B)
+    got = find_best_split(jnp.asarray(hist, jnp.float32),
+                          jnp.float32(g.sum()), jnp.float32(h.sum()),
+                          jnp.float32(n), jnp.asarray([B], np.int32),
+                          jnp.asarray([True]), jnp.asarray([True]),
+                          jnp.asarray(True), p)
+    assert int(got.threshold) == 5
+
+
+def test_grow_tree_structure_and_fit():
+    # single clean split on feature 0 at bin <= 7
+    rng = np.random.RandomState(0)
+    n = 1000
+    bins = np.stack([rng.randint(0, 16, n), rng.randint(0, 16, n)]).astype(np.int32)
+    target = np.where(bins[0] <= 7, 2.0, -1.0)
+    score = np.zeros(n)
+    g = (score - target).astype(np.float32)  # L2 gradients
+    h = np.ones(n, np.float32)
+    params = GrowParams(num_leaves=2, max_bin=16, min_data_in_leaf=5,
+                        min_sum_hessian_in_leaf=1e-3)
+    tree, leaf_id, delta = grow_tree(
+        jnp.asarray(bins), jnp.asarray([16, 16], np.int32),
+        jnp.zeros(2, bool), jnp.ones(2, bool),
+        jnp.asarray(g), jnp.asarray(h), jnp.ones(n, jnp.float32),
+        jnp.float32(1.0), params)
+    assert int(tree.num_leaves) == 2
+    assert int(tree.split_feature[0]) == 0
+    assert int(tree.split_bin[0]) == 7
+    # leaf outputs approximate targets (lr=1, L2 loss, one split)
+    lv = np.asarray(tree.leaf_value)
+    assert abs(lv[0] - 2.0) < 1e-3 and abs(lv[1] + 1.0) < 1e-3
+    # partition + delta agree
+    np.testing.assert_array_equal(np.asarray(leaf_id), np.where(bins[0] <= 7, 0, 1))
+    np.testing.assert_allclose(np.asarray(delta), lv[np.asarray(leaf_id)], rtol=1e-6)
+
+
+def test_grow_tree_depth_guard():
+    bins, g, h = _make_data(n=2000, f=4, B=32)
+    params = GrowParams(num_leaves=31, max_bin=32, min_data_in_leaf=5,
+                        min_sum_hessian_in_leaf=1e-3, max_depth=2)
+    tree, _, _ = grow_tree(
+        jnp.asarray(bins), jnp.full(4, 32, np.int32),
+        jnp.zeros(4, bool), jnp.ones(4, bool),
+        jnp.asarray(g), jnp.asarray(h), jnp.ones(2000, jnp.float32),
+        jnp.float32(0.1), params)
+    # max_depth=2 means at most 4 leaves
+    assert int(tree.num_leaves) <= 4
+    depths = np.asarray(tree.leaf_depth)[:int(tree.num_leaves)]
+    assert depths.max() <= 2
+
+
+def test_grow_tree_stops_without_gain():
+    # constant gradients and huge min_gain: no split possible
+    n = 300
+    bins = np.zeros((2, n), dtype=np.int32)  # all same bin -> no candidates
+    g = np.ones(n, np.float32)
+    h = np.ones(n, np.float32)
+    params = GrowParams(num_leaves=15, max_bin=8, min_data_in_leaf=5,
+                        min_sum_hessian_in_leaf=1e-3)
+    tree, leaf_id, delta = grow_tree(
+        jnp.asarray(bins), jnp.asarray([8, 8], np.int32),
+        jnp.zeros(2, bool), jnp.ones(2, bool),
+        jnp.asarray(g), jnp.asarray(h), jnp.ones(n, jnp.float32),
+        jnp.float32(1.0), params)
+    assert int(tree.num_leaves) == 1
+    np.testing.assert_array_equal(np.asarray(leaf_id), 0)
+
+
+def test_grow_tree_matches_oracle_sequence():
+    """Full leaf-wise growth vs a host oracle that replays the same policy."""
+    rng = np.random.RandomState(7)
+    n, F, B, L = 800, 3, 8, 6
+    bins = rng.randint(0, B, size=(F, n)).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = np.ones(n, np.float32)
+    p = SplitParams(min_data_in_leaf=10, min_sum_hessian_in_leaf=1e-3)
+    params = GrowParams(num_leaves=L, max_bin=B, min_data_in_leaf=10,
+                        min_sum_hessian_in_leaf=1e-3)
+
+    tree, leaf_id, _ = grow_tree(
+        jnp.asarray(bins), jnp.full(F, B, np.int32), jnp.zeros(F, bool),
+        jnp.ones(F, bool), jnp.asarray(g), jnp.asarray(h),
+        jnp.ones(n, jnp.float32), jnp.float32(1.0), params)
+
+    # Oracle: leaf-wise growth with per-leaf exhaustive search.
+    leaf = np.zeros(n, dtype=np.int64)
+    num_leaves = 1
+    num_bin = np.full(F, B, np.int32)
+    is_cat = np.zeros(F, bool)
+    splits = []
+    for _step in range(L - 1):
+        best = None
+        for l in range(num_leaves):
+            m = leaf == l
+            if m.sum() == 0:
+                continue
+            hist = _np_hist(bins[:, m], g[m], h[m], np.ones(m.sum()), B)
+            cand = _np_best_split(hist, g[m].sum(), h[m].sum(), m.sum(),
+                                  num_bin, is_cat, p)
+            if cand["feat"] >= 0 and (best is None or cand["gain"] > best[1]["gain"]):
+                best = (l, cand)
+        if best is None:
+            break
+        l, cand = best
+        splits.append((l, cand["feat"], cand["t"]))
+        m = (leaf == l) & (bins[cand["feat"]] > cand["t"])
+        leaf[m] = num_leaves
+        num_leaves += 1
+
+    assert int(tree.num_leaves) == num_leaves
+    got_splits = [(int(f), int(t)) for f, t in
+                  zip(np.asarray(tree.split_feature)[:num_leaves - 1],
+                      np.asarray(tree.split_bin)[:num_leaves - 1])]
+    assert got_splits == [(f, t) for _, f, t in splits]
+    np.testing.assert_array_equal(np.asarray(leaf_id), leaf)
