@@ -33,6 +33,39 @@ from .split import (BestSplit, SplitParams, find_best_split, leaf_output,
                     K_MIN_SCORE)
 
 
+class SerialComm(NamedTuple):
+    """Single-device communication strategy: no collectives.
+
+    grow_tree is parameterized by a static ``comm`` object so the
+    distributed learners (lightgbm_tpu/parallel/comm.py) can swap the
+    reference's network calls (data_parallel_tree_learner.cpp ReduceScatter/
+    Allreduce, feature_parallel Allreduce-max, voting Allgather+elect) into
+    the same growth loop without duplicating it.  Interface:
+
+      reduce_sums((g, h, c))            -> globally-reduced leaf totals
+      root_split(...)   -> BestSplit [] for the root leaf
+      children_splits(...) -> BestSplit [2] for a fresh left/right pair
+    """
+
+    def reduce_sums(self, sums):
+        return sums
+
+    def root_split(self, bins, g, h, w, root_g, root_h, root_c,
+                   num_bin, is_cat, feat_mask, max_bin: int, sp: SplitParams):
+        hist = build_root_histogram(bins, g, h, w, max_bin)
+        return find_best_split(hist, root_g, root_h, root_c, num_bin, is_cat,
+                               feat_mask, jnp.asarray(True), sp)
+
+    def children_splits(self, bins, g, h, w, leaf_id, parent_leaf, right_leaf,
+                        totals_g, totals_h, totals_c, can,
+                        num_bin, is_cat, feat_mask, max_bin: int,
+                        sp: SplitParams):
+        hists = build_children_histograms(bins, g, h, w, leaf_id,
+                                          parent_leaf, right_leaf, max_bin)
+        return find_best_split(hists, totals_g, totals_h, totals_c,
+                               num_bin, is_cat, feat_mask, can, sp)
+
+
 class GrowParams(NamedTuple):
     """Static tree-growth configuration."""
     num_leaves: int = 31
@@ -108,22 +141,33 @@ def _store_leaf_split(state: _GrowState, leaf, split: BestSplit) -> _GrowState:
     )
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
+@functools.partial(jax.jit, static_argnames=("params", "comm"))
 def grow_tree(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
-              learning_rate, params: GrowParams):
+              learning_rate, params: GrowParams, comm=None):
     """Grow one tree.  All inputs are device arrays.
 
     Args:
-      bins: [F, N] feature-major bin codes.
+      bins: [F, N] feature-major bin codes (F and N are the *local* shard
+        shapes when called under shard_map with a distributed comm).
       num_bin: [F] i32; is_cat: [F] bool; feat_mask: [F] bool.
       grad, hess: [N] f32 raw gradients/hessians.
       row_weight: [N] f32 bagging/GOSS weight (0 excludes a row from
         training; weights also scale grad/hess like the reference's
         gradient amplification).
+      comm: static communication strategy (SerialComm by default; see
+        lightgbm_tpu/parallel/comm.py for the distributed learners).
     Returns (TreeArrays, leaf_id [N] i32, output_delta [N] f32) where
       output_delta = shrunk leaf value per row (the train-score update,
       serial_tree_learner AddPredictionToScore semantics).
     """
+    return _grow_tree_impl(bins, num_bin, is_cat, feat_mask, grad, hess,
+                           row_weight, learning_rate, params,
+                           comm or SerialComm())
+
+
+def _grow_tree_impl(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
+                    learning_rate, params: GrowParams, comm):
+    """Unjitted growth loop — callable inside shard_map."""
     L = params.num_leaves
     B = params.max_bin
     F, N = bins.shape
@@ -132,14 +176,12 @@ def grow_tree(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
     g = grad * row_weight
     h = hess * row_weight
 
-    root_g = jnp.sum(g)
-    root_h = jnp.sum(h)
-    root_c = jnp.sum(row_weight)
+    root_g, root_h, root_c = comm.reduce_sums(
+        (jnp.sum(g), jnp.sum(h), jnp.sum(row_weight)))
 
-    hist_root = build_root_histogram(bins, g, h, row_weight, B)
-    root_split = find_best_split(hist_root, root_g, root_h, root_c,
-                                 num_bin, is_cat, feat_mask,
-                                 jnp.asarray(True), sp)
+    root_split = comm.root_split(bins, g, h, row_weight,
+                                 root_g, root_h, root_c,
+                                 num_bin, is_cat, feat_mask, B, sp)
 
     neg_inf = jnp.full((L,), K_MIN_SCORE, dtype=jnp.float32)
     state = _GrowState(
@@ -250,16 +292,16 @@ def grow_tree(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
         )
 
         # --- child histograms + child best splits -------------------------
-        hists = build_children_histograms(
-            bins, g, h, row_weight, new_state.leaf_id, best_leaf, right_leaf, B)
         child_depth_ok = jnp.logical_or(params.max_depth <= 0,
                                         depth + 1 < params.max_depth)
         totals_g = jnp.stack([left_g, right_g])
         totals_h = jnp.stack([left_h, right_h])
         totals_c = jnp.stack([left_c, right_c])
         can = jnp.stack([do_split & child_depth_ok] * 2)
-        child_split = find_best_split(hists, totals_g, totals_h, totals_c,
-                                      num_bin, is_cat, feat_mask, can, sp)
+        child_split = comm.children_splits(
+            bins, g, h, row_weight, new_state.leaf_id, best_leaf, right_leaf,
+            totals_g, totals_h, totals_c, can, num_bin, is_cat, feat_mask,
+            B, sp)
 
         # Invalidate the split leaf's old record, then store children.
         new_state = new_state._replace(

@@ -21,6 +21,7 @@ Semantics preserved from the reference scan:
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -61,19 +62,15 @@ def leaf_output(sum_g, sum_h, l1: float, l2: float):
     return -jnp.sign(sum_g) * reg / (sum_h + l2)
 
 
-def find_best_split(hist, total_g, total_h, total_c, num_bin, is_cat,
-                    feat_mask, can_split, p: SplitParams) -> BestSplit:
-    """Best split for one leaf (or a batch of leaves via leading dims).
+def per_feature_scan(hist, total_g, total_h, total_c, num_bin, is_cat,
+                     feat_mask, p: SplitParams):
+    """The cumulative-scan half of split finding: per-feature best candidate.
 
-    Args:
-      hist: [..., F, B, 3] per-feature histograms (sum_g, sum_h, count).
-      total_g/total_h/total_c: [...] leaf totals.
-      num_bin: [F] i32 bins in use per feature.
-      is_cat: [F] bool categorical flag per feature.
-      feat_mask: [F] bool usable features this tree (feature_fraction).
-      can_split: [...] bool depth/validity guard for the leaf.
-      p: static constraints.
-    Returns BestSplit with fields shaped [...].
+    Returns (feat_best_gain [..., F] with the parent gain_shift NOT yet
+    subtracted and invalid candidates at -inf, feat_best_t [..., F] i32,
+    left_g/left_h/left_c [..., F, B]).  Exposed separately so the voting
+    learner can elect features by local gain (GlobalVoting,
+    voting_parallel_tree_learner.cpp:157-186) before the global reduce.
     """
     F, B = hist.shape[-3], hist.shape[-2]
     tg = total_g[..., None, None]
@@ -121,11 +118,31 @@ def find_best_split(hist, total_g, total_h, total_c, num_bin, is_cat,
     feat_best_gain = jnp.max(gain, axis=-1)
     is_best_t = gain == feat_best_gain[..., None]
     feat_best_t = jnp.max(jnp.where(is_best_t, bins[None, :], -1), axis=-1)
+    feat_best_gain = jnp.where(jnp.isfinite(feat_best_gain), feat_best_gain,
+                               K_MIN_SCORE)
+    return feat_best_gain, feat_best_t, left_g, left_h, left_c
+
+
+def find_best_split(hist, total_g, total_h, total_c, num_bin, is_cat,
+                    feat_mask, can_split, p: SplitParams) -> BestSplit:
+    """Best split for one leaf (or a batch of leaves via leading dims).
+
+    Args:
+      hist: [..., F, B, 3] per-feature histograms (sum_g, sum_h, count).
+      total_g/total_h/total_c: [...] leaf totals.
+      num_bin: [F] i32 bins in use per feature.
+      is_cat: [F] bool categorical flag per feature.
+      feat_mask: [F] bool usable features this tree (feature_fraction).
+      can_split: [...] bool depth/validity guard for the leaf.
+      p: static constraints.
+    Returns BestSplit with fields shaped [...].
+    """
+    feat_best_gain, feat_best_t, left_g, left_h, left_c = per_feature_scan(
+        hist, total_g, total_h, total_c, num_bin, is_cat, feat_mask, p)
+    gain_shift = leaf_split_gain(total_g, total_h, p.lambda_l1, p.lambda_l2)
 
     # Across features: max gain, ties pick the smallest feature index
     # (argmax returns the first occurrence).
-    feat_best_gain = jnp.where(jnp.isfinite(feat_best_gain), feat_best_gain,
-                               K_MIN_SCORE)
     best_f = jnp.argmax(feat_best_gain, axis=-1).astype(jnp.int32)
     best_gain = jnp.take_along_axis(feat_best_gain, best_f[..., None],
                                     axis=-1)[..., 0]
@@ -148,3 +165,25 @@ def find_best_split(hist, total_g, total_h, total_c, num_bin, is_cat,
         left_sum_h=_gather_ft(left_h).astype(jnp.float32),
         left_count=_gather_ft(left_c).astype(jnp.float32),
     )
+
+
+def better_split(a: BestSplit, b: BestSplit) -> BestSplit:
+    """Elementwise pick of the better of two split records.
+
+    SplitInfo::operator> semantics (split_info.hpp:100-105): larger gain
+    wins; equal gains break the tie toward the smaller feature index.  This
+    is the structured-dtype replacement for the reference's raw-byte
+    SplitInfo::MaxReducer network callback (split_info.hpp:58-74)."""
+    a_wins = jnp.logical_or(
+        a.gain > b.gain,
+        jnp.logical_and(a.gain == b.gain, a.feature <= b.feature))
+    return jax.tree.map(lambda x, y: jnp.where(a_wins, x, y), a, b)
+
+
+def combine_gathered_splits(gathered: BestSplit, num_shards: int) -> BestSplit:
+    """Reduce an all_gather'ed BestSplit (leading axis = shard) to the global
+    winner — the Allreduce(SplitInfo::MaxReducer) of the parallel learners
+    (feature_parallel_tree_learner.cpp:47-69; data_parallel 219-242)."""
+    shards = [jax.tree.map(lambda f, i=i: f[i], gathered)
+              for i in range(num_shards)]
+    return functools.reduce(better_split, shards)

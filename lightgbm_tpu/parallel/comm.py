@@ -1,0 +1,285 @@
+"""Distributed communication strategies for the tree-growth loop.
+
+The reference implements three parallel tree learners over a hand-rolled
+socket/MPI collective stack (src/network/):
+
+  * feature-parallel (feature_parallel_tree_learner.cpp): every machine
+    holds ALL data; split *finding* is sharded by feature; the only
+    communication is Allreduce(SplitInfo::MaxReducer).
+  * data-parallel (data_parallel_tree_learner.cpp): rows are sharded;
+    local histograms are ReduceScatter'ed so each machine owns the fully
+    reduced histograms of a feature block (142-160); best split on owned
+    features; Allreduce(MaxReducer) of the 2 candidate SplitInfos (219-242).
+  * voting-parallel / PV-tree (voting_parallel_tree_learner.cpp): data-
+    parallel with communication cut to O(2*top_k*max_bin): local per-feature
+    best splits -> local top-k -> Allgather of candidates (332) ->
+    GlobalVoting (157-186) -> reduce only elected features' histograms
+    (188-244, 354-356) -> full-precision split on elected features.
+
+Here each strategy is a static NamedTuple plugged into
+ops.grow._grow_tree_impl under ``jax.shard_map``; the byte-level reducers
+become XLA collectives on structured values: psum / psum_scatter for
+HistogramBinEntry sums, and an all_gather + tournament
+(ops.split.combine_gathered_splits) for the SplitInfo max-reduce.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.histogram import build_children_histograms, build_root_histogram
+from ..ops.split import (BestSplit, SplitParams, combine_gathered_splits,
+                         find_best_split, leaf_split_gain, per_feature_scan)
+
+
+def _psum_tree(x, axis_name):
+    return jax.tree.map(lambda a: lax.psum(a, axis_name), x)
+
+
+def _allgather_combine(split: BestSplit, axis_name: str,
+                       num_shards: int) -> BestSplit:
+    """Allreduce(SplitInfo::MaxReducer): tiny all_gather + tournament."""
+    gathered = jax.tree.map(
+        lambda f: lax.all_gather(f, axis_name, axis=0), split)
+    return combine_gathered_splits(gathered, num_shards)
+
+
+def _offset_features(split: BestSplit, offset) -> BestSplit:
+    """Map a shard-local feature index to the global index."""
+    return split._replace(
+        feature=jnp.where(split.feature >= 0, split.feature + offset,
+                          split.feature))
+
+
+def _pad_feature_dim(hist, num_bin, is_cat, feat_mask, num_shards: int):
+    """Pad the feature dimension to a multiple of num_shards so the
+    histogram block layout of the reduce-scatter is uniform (the reference
+    computes ragged per-rank block sizes instead,
+    data_parallel_tree_learner.cpp:48-110 — fixed shapes want padding)."""
+    F = hist.shape[-3]
+    pad = (-F) % num_shards
+    if pad:
+        widths = [(0, 0)] * hist.ndim
+        widths[hist.ndim - 3] = (0, pad)
+        hist = jnp.pad(hist, widths)
+        num_bin = jnp.pad(num_bin, (0, pad))
+        is_cat = jnp.pad(is_cat, (0, pad))
+        feat_mask = jnp.pad(feat_mask, (0, pad))
+    return hist, num_bin, is_cat, feat_mask, F + pad
+
+
+class DataParallelComm(NamedTuple):
+    """Rows sharded over ``axis_name``; histograms globally reduced.
+
+    hist_reduce:
+      * "reduce_scatter" (default, faithful to the reference): psum_scatter
+        the [*, F, B, 3] histogram along features, find the best split on
+        the owned block, then all_gather+tournament the tiny SplitInfo.
+        Comm volume per split: one histogram pass over ICI + k SplitInfos.
+      * "psum": allreduce the full histogram and find splits redundantly on
+        every shard.  Simpler lowering; sometimes faster on small meshes.
+    """
+    axis_name: str = "data"
+    num_shards: int = 1
+    hist_reduce: str = "reduce_scatter"
+
+    def reduce_sums(self, sums):
+        # Root Allreduce of <count, sum_g, sum_h> (data_parallel:112-139).
+        return _psum_tree(sums, self.axis_name)
+
+    def _split_from_hist(self, hist, totals_g, totals_h, totals_c, can,
+                         num_bin, is_cat, feat_mask, sp):
+        if self.hist_reduce == "psum":
+            hist = lax.psum(hist, self.axis_name)
+            return find_best_split(hist, totals_g, totals_h, totals_c,
+                                   num_bin, is_cat, feat_mask, can, sp)
+        # --- reduce-scatter by feature block ------------------------------
+        k = self.num_shards
+        hist, num_bin, is_cat, feat_mask, F_pad = _pad_feature_dim(
+            hist, num_bin, is_cat, feat_mask, k)
+        f_blk = F_pad // k
+        hist_blk = lax.psum_scatter(hist, self.axis_name,
+                                    scatter_dimension=hist.ndim - 3,
+                                    tiled=True)
+        shard = lax.axis_index(self.axis_name)
+        offset = shard * f_blk
+        nb = lax.dynamic_slice_in_dim(num_bin, offset, f_blk)
+        ic = lax.dynamic_slice_in_dim(is_cat, offset, f_blk)
+        fm = lax.dynamic_slice_in_dim(feat_mask, offset, f_blk)
+        local = find_best_split(hist_blk, totals_g, totals_h, totals_c,
+                                nb, ic, fm, can, sp)
+        local = _offset_features(local, offset)
+        return _allgather_combine(local, self.axis_name, k)
+
+    def root_split(self, bins, g, h, w, root_g, root_h, root_c,
+                   num_bin, is_cat, feat_mask, max_bin: int, sp: SplitParams):
+        hist = build_root_histogram(bins, g, h, w, max_bin)
+        return self._split_from_hist(hist, root_g, root_h, root_c,
+                                     jnp.asarray(True), num_bin, is_cat,
+                                     feat_mask, sp)
+
+    def children_splits(self, bins, g, h, w, leaf_id, parent_leaf, right_leaf,
+                        totals_g, totals_h, totals_c, can,
+                        num_bin, is_cat, feat_mask, max_bin: int,
+                        sp: SplitParams):
+        hists = build_children_histograms(bins, g, h, w, leaf_id,
+                                          parent_leaf, right_leaf, max_bin)
+        return self._split_from_hist(hists, totals_g, totals_h, totals_c,
+                                     can, num_bin, is_cat, feat_mask, sp)
+
+
+class FeatureParallelComm(NamedTuple):
+    """All data replicated; split finding sharded by feature block.
+
+    Mirrors FeatureParallelTreeLearner: each shard scans only its feature
+    block (the reference's bin-count-balanced assignment,
+    feature_parallel_tree_learner.cpp:26-45, becomes a uniform block — XLA
+    wants equal shapes), then Allreduce(MaxReducer) over shards (47-69).
+    All shards then apply the winning split to their (full) row set
+    identically — no data exchange.
+
+    f_block: static features-per-shard (ceil(F / num_shards); the caller
+    pads feature metadata to num_shards * f_block).
+    """
+    axis_name: str = "feature"
+    num_shards: int = 1
+    f_block: int = 1
+
+    def reduce_sums(self, sums):
+        return sums  # every shard already holds all rows
+
+    def _local_meta(self, num_bin, is_cat, feat_mask):
+        shard = lax.axis_index(self.axis_name)
+        offset = shard * self.f_block
+        nb = lax.dynamic_slice_in_dim(num_bin, offset, self.f_block)
+        ic = lax.dynamic_slice_in_dim(is_cat, offset, self.f_block)
+        fm = lax.dynamic_slice_in_dim(feat_mask, offset, self.f_block)
+        return offset, nb, ic, fm
+
+    def root_split(self, bins, g, h, w, root_g, root_h, root_c,
+                   num_bin, is_cat, feat_mask, max_bin: int, sp: SplitParams):
+        offset, nb, ic, fm = self._local_meta(num_bin, is_cat, feat_mask)
+        bins_blk = lax.dynamic_slice_in_dim(bins, offset, self.f_block, axis=0)
+        hist = build_root_histogram(bins_blk, g, h, w, max_bin)
+        local = find_best_split(hist, root_g, root_h, root_c, nb, ic, fm,
+                                jnp.asarray(True), sp)
+        local = _offset_features(local, offset)
+        return _allgather_combine(local, self.axis_name, self.num_shards)
+
+    def children_splits(self, bins, g, h, w, leaf_id, parent_leaf, right_leaf,
+                        totals_g, totals_h, totals_c, can,
+                        num_bin, is_cat, feat_mask, max_bin: int,
+                        sp: SplitParams):
+        offset, nb, ic, fm = self._local_meta(num_bin, is_cat, feat_mask)
+        bins_blk = lax.dynamic_slice_in_dim(bins, offset, self.f_block, axis=0)
+        hists = build_children_histograms(bins_blk, g, h, w, leaf_id,
+                                          parent_leaf, right_leaf, max_bin)
+        local = find_best_split(hists, totals_g, totals_h, totals_c,
+                                nb, ic, fm, can, sp)
+        local = _offset_features(local, offset)
+        return _allgather_combine(local, self.axis_name, self.num_shards)
+
+
+class VotingParallelComm(NamedTuple):
+    """PV-tree: data-parallel with top-k feature election.
+
+    Per leaf: local per-feature best gains (per_feature_scan on the LOCAL
+    histogram with locally derived totals and 1/num_shards-scaled
+    constraints, voting_parallel_tree_learner.cpp:52-54) -> local top-k
+    feature ids -> all_gather candidates -> election by summed local gain
+    (GlobalVoting, 157-186) -> psum of only the elected features'
+    histograms (CopyLocalHistogram + ReduceScatter, 188-244) -> exact split
+    on elected features against GLOBAL totals -> winner (already replicated,
+    no final reduce needed).
+    """
+    axis_name: str = "data"
+    num_shards: int = 1
+    top_k: int = 20
+
+    def reduce_sums(self, sums):
+        return _psum_tree(sums, self.axis_name)
+
+    def _local_sp(self, sp: SplitParams) -> SplitParams:
+        k = self.num_shards
+        return sp._replace(min_data_in_leaf=sp.min_data_in_leaf / k,
+                           min_sum_hessian_in_leaf=(
+                               sp.min_sum_hessian_in_leaf / k))
+
+    def _elect_and_split(self, hist, totals_g, totals_h, totals_c, can,
+                         num_bin, is_cat, feat_mask, sp):
+        """hist: [C, F, B, 3] local histograms of C candidate leaves."""
+        C, F = hist.shape[0], hist.shape[1]
+        K = min(self.top_k, F)
+        # Local leaf totals derive from the local histogram itself.
+        loc = jnp.sum(hist, axis=2)                        # [C, F, 3]
+        loc_g = jnp.max(loc[..., 0], axis=1)               # any feature's
+        loc_h = jnp.max(loc[..., 1], axis=1)               # sums are equal;
+        loc_c = jnp.max(loc[..., 2], axis=1)               # max is cheap
+        local_sp = self._local_sp(sp)
+        feat_gain, _, _, _, _ = per_feature_scan(
+            hist, loc_g, loc_h, loc_c, num_bin, is_cat, feat_mask,
+            local_sp)                                      # [C, F]
+        # Vote weight = true local split gain (parent shift subtracted)
+        # scaled by the leaf's local row count, mirroring GlobalVoting's
+        # gain * (left_count + right_count) weighting
+        # (voting_parallel_tree_learner.cpp:157-186).
+        shift = leaf_split_gain(loc_g, loc_h, local_sp.lambda_l1,
+                                local_sp.lambda_l2)        # [C]
+        score = jnp.where(jnp.isfinite(feat_gain),
+                          jnp.maximum(feat_gain - shift[:, None], 0.0)
+                          * loc_c[:, None], 0.0)           # [C, F]
+        top_gain, top_ids = lax.top_k(score, K)            # [C, K]
+
+        # ---- GlobalVoting: score features by summed weighted local gains
+        gains_all = lax.all_gather(top_gain, self.axis_name)   # [S, C, K]
+        ids_all = lax.all_gather(top_ids, self.axis_name)      # [S, C, K]
+        votes = jnp.zeros((C, F), jnp.float32)
+        flat_ids = ids_all.transpose(1, 0, 2).reshape(C, -1)   # [C, S*K]
+        flat_gain = gains_all.transpose(1, 0, 2).reshape(C, -1)
+        votes = jax.vmap(lambda v, i, s: v.at[i].add(s))(
+            votes, flat_ids, flat_gain)
+        _, elected = lax.top_k(votes, K)                   # [C, K] global ids
+        # Ascending feature order keeps the final argmax tie-break identical
+        # to the serial scan (smallest feature index wins).
+        elected = jnp.sort(elected, axis=-1)
+
+        # ---- reduce only the elected features' histograms ----------------
+        hist_el = jax.vmap(lambda hc, ids: hc[ids])(hist, elected)
+        hist_el = lax.psum(hist_el, self.axis_name)        # [C, K, B, 3]
+        nb_el = num_bin[elected]
+        ic_el = is_cat[elected]
+        fm_el = feat_mask[elected]
+
+        def _one(hist_c, tg, th, tc, cn, nb, ic, fm):
+            return find_best_split(hist_c, tg, th, tc, nb, ic, fm, cn, sp)
+
+        local_best = jax.vmap(_one)(hist_el, totals_g, totals_h, totals_c,
+                                    can, nb_el, ic_el, fm_el)
+        # Map elected-set index back to the global feature index.
+        real_feat = jax.vmap(lambda ids, f: ids[jnp.maximum(f, 0)])(
+            elected, local_best.feature)
+        return local_best._replace(
+            feature=jnp.where(local_best.feature >= 0, real_feat,
+                              local_best.feature))
+
+    def root_split(self, bins, g, h, w, root_g, root_h, root_c,
+                   num_bin, is_cat, feat_mask, max_bin: int, sp: SplitParams):
+        hist = build_root_histogram(bins, g, h, w, max_bin)
+        best = self._elect_and_split(
+            hist[None], jnp.asarray([root_g]), jnp.asarray([root_h]),
+            jnp.asarray([root_c]), jnp.asarray([True]),
+            num_bin, is_cat, feat_mask, sp)
+        return jax.tree.map(lambda f: f[0], best)
+
+    def children_splits(self, bins, g, h, w, leaf_id, parent_leaf, right_leaf,
+                        totals_g, totals_h, totals_c, can,
+                        num_bin, is_cat, feat_mask, max_bin: int,
+                        sp: SplitParams):
+        hists = build_children_histograms(bins, g, h, w, leaf_id,
+                                          parent_leaf, right_leaf, max_bin)
+        return self._elect_and_split(hists, totals_g, totals_h, totals_c,
+                                     can, num_bin, is_cat, feat_mask, sp)

@@ -1,0 +1,90 @@
+"""Mesh-level entry points for distributed tree growth.
+
+Builds a jitted ``grow`` function that runs ops.grow._grow_tree_impl under
+``jax.shard_map`` over a ``jax.sharding.Mesh`` with the communication
+strategy of the requested tree_learner type ("data" | "feature" | "voting"
+— the reference's TreeLearner factory, src/treelearner/tree_learner.cpp).
+The returned TreeArrays are replicated (every shard deterministically grows
+the identical tree); leaf_id and the score delta stay row-sharded in
+data/voting modes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.grow import GrowParams, _grow_tree_impl
+from .comm import DataParallelComm, FeatureParallelComm, VotingParallelComm
+
+
+def make_comm(mode: str, axis_name: str, num_shards: int,
+              num_features: int, top_k: int = 20,
+              hist_reduce: str = "reduce_scatter"):
+    if mode == "data":
+        return DataParallelComm(axis_name, num_shards, hist_reduce)
+    if mode == "feature":
+        f_block = -(-num_features // num_shards)
+        return FeatureParallelComm(axis_name, num_shards, f_block)
+    if mode == "voting":
+        return VotingParallelComm(axis_name, num_shards, top_k)
+    raise ValueError(f"unknown parallel tree learner mode: {mode!r}")
+
+
+def make_parallel_grow(mesh: Mesh, mode: str, params: GrowParams,
+                       axis_name: Optional[str] = None, top_k: int = 20,
+                       hist_reduce: str = "reduce_scatter"):
+    """Build a jitted distributed grow(bins, num_bin, is_cat, feat_mask,
+    grad, hess, row_weight, learning_rate) for the given mesh.
+
+    Accepts unpadded inputs: rows are padded to a multiple of the mesh axis
+    with zero row_weight (dead rows), features to a multiple with a False
+    feat_mask (dead features); outputs are cropped back.
+    """
+    axis_name = axis_name or mesh.axis_names[0]
+    k = mesh.shape[axis_name]
+    row_sharded = mode in ("data", "voting")
+
+    if row_sharded:
+        in_specs = (P(None, axis_name), P(), P(), P(),
+                    P(axis_name), P(axis_name), P(axis_name), P())
+        out_specs = (P(), P(axis_name), P(axis_name))
+    else:
+        in_specs = (P(None, None), P(), P(), P(), P(), P(), P(), P())
+        out_specs = (P(), P(), P())
+
+    @functools.partial(jax.jit, static_argnames=())
+    def grow(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
+             learning_rate):
+        F, N = bins.shape
+        pad_n = ((-N) % k) if row_sharded else 0
+        pad_f = ((-F) % k) if mode == "feature" else 0
+        if pad_n or pad_f:
+            bins = jnp.pad(bins, ((0, pad_f), (0, pad_n)))
+            grad = jnp.pad(grad, (0, pad_n))
+            hess = jnp.pad(hess, (0, pad_n))
+            row_weight = jnp.pad(row_weight, (0, pad_n))  # 0 = dead row
+        if pad_f:
+            num_bin = jnp.pad(num_bin, (0, pad_f))
+            is_cat = jnp.pad(is_cat, (0, pad_f))
+            feat_mask = jnp.pad(feat_mask, (0, pad_f))  # False = dead feat
+
+        comm = make_comm(mode, axis_name, k, F + pad_f, top_k, hist_reduce)
+
+        def local_fn(b, nb, ic, fm, g, h, w, lr):
+            return _grow_tree_impl(b, nb, ic, fm, g, h, w, lr, params, comm)
+
+        sharded = jax.shard_map(local_fn, mesh=mesh, in_specs=in_specs,
+                                out_specs=out_specs, check_vma=False)
+        tree, leaf_id, delta = sharded(bins, num_bin, is_cat, feat_mask,
+                                       grad, hess, row_weight, learning_rate)
+        if pad_n:
+            leaf_id = leaf_id[:N]
+            delta = delta[:N]
+        return tree, leaf_id, delta
+
+    return grow

@@ -1,0 +1,140 @@
+"""Distributed tree-growth parity on an 8-virtual-device CPU mesh.
+
+The reference validates parallel learning only by running two local
+processes by hand (examples/parallel_learning/); here every parallel
+learner is checked for exact structural parity against the serial grower
+on the same data — the strongest guarantee the reference's design implies
+(data/feature-parallel are mathematically exact reformulations; voting is
+exact whenever the elected set contains the true best feature).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh
+
+from lightgbm_tpu.ops.grow import GrowParams, grow_tree
+from lightgbm_tpu.parallel import make_parallel_grow
+
+
+def _make_data(seed=0, n=512, f=6, B=16):
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, B, size=(f, n)).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = np.abs(rng.normal(size=n)).astype(np.float32) + 0.1
+    return bins, g, h
+
+
+def _mesh(n=8, axis="shard"):
+    devs = jax.devices()
+    assert len(devs) >= n, "conftest must force 8 CPU devices"
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def _grow_serial(bins, g, h, params, B):
+    F, N = bins.shape
+    num_bin = jnp.full((F,), B, jnp.int32)
+    is_cat = jnp.zeros((F,), bool)
+    feat_mask = jnp.ones((F,), bool)
+    w = jnp.ones((N,), jnp.float32)
+    return grow_tree(jnp.asarray(bins), num_bin, is_cat, feat_mask,
+                     jnp.asarray(g), jnp.asarray(h), w,
+                     jnp.float32(0.1), params)
+
+
+def _grow_parallel(mode, bins, g, h, params, B, n_dev=8, **kw):
+    mesh = _mesh(n_dev)
+    F, N = bins.shape
+    fn = make_parallel_grow(mesh, mode, params, **kw)
+    num_bin = jnp.full((F,), B, jnp.int32)
+    is_cat = jnp.zeros((F,), bool)
+    feat_mask = jnp.ones((F,), bool)
+    w = jnp.ones((N,), jnp.float32)
+    return fn(jnp.asarray(bins), num_bin, is_cat, feat_mask,
+              jnp.asarray(g), jnp.asarray(h), w, jnp.float32(0.1))
+
+
+def _assert_tree_equal(ta, tb, structural_only=False):
+    assert int(ta.num_leaves) == int(tb.num_leaves)
+    np.testing.assert_array_equal(np.asarray(ta.split_feature),
+                                  np.asarray(tb.split_feature))
+    np.testing.assert_array_equal(np.asarray(ta.split_bin),
+                                  np.asarray(tb.split_bin))
+    np.testing.assert_array_equal(np.asarray(ta.left_child),
+                                  np.asarray(tb.left_child))
+    np.testing.assert_array_equal(np.asarray(ta.right_child),
+                                  np.asarray(tb.right_child))
+    if not structural_only:
+        np.testing.assert_allclose(np.asarray(ta.leaf_value),
+                                   np.asarray(tb.leaf_value),
+                                   rtol=2e-4, atol=2e-6)
+        np.testing.assert_array_equal(np.asarray(ta.leaf_count),
+                                      np.asarray(tb.leaf_count))
+
+
+PARAMS = GrowParams(num_leaves=15, max_bin=16, min_data_in_leaf=5,
+                    min_sum_hessian_in_leaf=1e-3)
+
+
+@pytest.mark.parametrize("hist_reduce", ["psum", "reduce_scatter"])
+def test_data_parallel_matches_serial(hist_reduce):
+    bins, g, h = _make_data()
+    ts, leaf_s, delta_s = _grow_serial(bins, g, h, PARAMS, 16)
+    tp, leaf_p, delta_p = _grow_parallel("data", bins, g, h, PARAMS, 16,
+                                         hist_reduce=hist_reduce)
+    _assert_tree_equal(ts, tp)
+    np.testing.assert_array_equal(np.asarray(leaf_s), np.asarray(leaf_p))
+    np.testing.assert_allclose(np.asarray(delta_s), np.asarray(delta_p),
+                               rtol=2e-4, atol=2e-6)
+
+
+def test_feature_parallel_matches_serial():
+    bins, g, h = _make_data(seed=1)
+    ts, _, _ = _grow_serial(bins, g, h, PARAMS, 16)
+    tp, leaf_p, _ = _grow_parallel("feature", bins, g, h, PARAMS, 16)
+    _assert_tree_equal(ts, tp)
+
+
+def test_feature_parallel_uneven_features():
+    # 6 features over 8 shards and 10 features over 8 shards (padding paths)
+    for f in (6, 10):
+        bins, g, h = _make_data(seed=2, f=f)
+        ts, _, _ = _grow_serial(bins, g, h, PARAMS, 16)
+        tp, _, _ = _grow_parallel("feature", bins, g, h, PARAMS, 16)
+        _assert_tree_equal(ts, tp)
+
+
+def test_data_parallel_uneven_rows():
+    bins, g, h = _make_data(seed=3, n=509)  # not divisible by 8
+    ts, leaf_s, delta_s = _grow_serial(bins, g, h, PARAMS, 16)
+    tp, leaf_p, delta_p = _grow_parallel("data", bins, g, h, PARAMS, 16)
+    _assert_tree_equal(ts, tp)
+    np.testing.assert_array_equal(np.asarray(leaf_s), np.asarray(leaf_p))
+
+
+def test_voting_parallel_matches_serial_with_full_topk():
+    # top_k >= F makes the election lossless -> exact parity with serial.
+    bins, g, h = _make_data(seed=4)
+    ts, _, _ = _grow_serial(bins, g, h, PARAMS, 16)
+    tp, _, _ = _grow_parallel("voting", bins, g, h, PARAMS, 16, top_k=6)
+    _assert_tree_equal(ts, tp)
+
+
+def test_voting_parallel_small_topk_reasonable():
+    # With top_k < F voting is approximate; the tree must still be a valid
+    # gainful tree (num_leaves grown, finite leaf values).
+    bins, g, h = _make_data(seed=5, f=12)
+    tp, leaf_p, delta_p = _grow_parallel("voting", bins, g, h, PARAMS, 16,
+                                         top_k=3)
+    assert int(tp.num_leaves) > 1
+    assert np.isfinite(np.asarray(tp.leaf_value)).all()
+    assert np.isfinite(np.asarray(delta_p)).all()
+
+
+def test_mesh_size_2_and_4():
+    bins, g, h = _make_data(seed=6)
+    ts, _, _ = _grow_serial(bins, g, h, PARAMS, 16)
+    for n_dev in (2, 4):
+        tp, _, _ = _grow_parallel("data", bins, g, h, PARAMS, 16, n_dev=n_dev)
+        _assert_tree_equal(ts, tp)
