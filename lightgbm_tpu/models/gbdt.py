@@ -107,6 +107,31 @@ class GBDT:
         self._feature_rng = np.random.RandomState(cfg.feature_fraction_seed)
         self._row_weight = jnp.ones(self.num_data, jnp.float32)
         self._grad_fn = jax.jit(self.objective.gradients)
+        self._grow_fn = self._make_grow_fn()
+
+    def _make_grow_fn(self):
+        """Pick the tree learner (TreeLearner::CreateTreeLearner,
+        tree_learner.cpp:1-26): serial, or a distributed learner over a
+        device mesh when tree_learner != serial and >1 device is present.
+        num_machines bounds the mesh size (it is the reference's machine
+        count; here it is a device count)."""
+        cfg = self.config
+        if getattr(cfg, "is_parallel", False):
+            ndev = len(jax.devices())
+            k = min(cfg.num_machines, ndev)
+            if k > 1:
+                from jax.sharding import Mesh
+                from ..parallel import make_parallel_grow
+                mesh = Mesh(np.array(jax.devices()[:k]), ("data",))
+                log.info("Using %s-parallel tree learner over %d devices",
+                         cfg.tree_learner, k)
+                return make_parallel_grow(mesh, cfg.tree_learner,
+                                          self.grow_params, top_k=cfg.top_k)
+            log.warning("tree_learner=%s requested but only %d device(s) "
+                        "available; falling back to serial",
+                        cfg.tree_learner, ndev)
+        params = self.grow_params
+        return lambda *args: grow_tree(*args, params)
 
     def add_valid_dataset(self, valid_set: BinnedDataset) -> None:
         """GBDT::AddValidDataset (gbdt.cpp:169-199)."""
@@ -167,10 +192,10 @@ class GBDT:
         could_split_any = False
         for cls in range(self.num_class):
             feat_mask = self._feature_mask()
-            tree_arrays, leaf_id, delta = grow_tree(
+            tree_arrays, leaf_id, delta = self._grow_fn(
                 self.train_data.bins, self.num_bin, self.is_cat, feat_mask,
                 grad[cls], hess[cls], row_weight,
-                jnp.float32(self.shrinkage_rate), self.grow_params)
+                jnp.float32(self.shrinkage_rate))
             self.train_data.score = self.train_data.score.at[cls].add(delta)
             host_tree = Tree.from_arrays(
                 tree_arrays, self.train_set.mappers,

@@ -28,7 +28,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .histogram import build_children_histograms, build_root_histogram
+from .histogram import children_histograms, root_histogram
 from .split import (BestSplit, SplitParams, find_best_split, leaf_output,
                     K_MIN_SCORE)
 
@@ -52,7 +52,7 @@ class SerialComm(NamedTuple):
 
     def root_split(self, bins, g, h, w, root_g, root_h, root_c,
                    num_bin, is_cat, feat_mask, max_bin: int, sp: SplitParams):
-        hist = build_root_histogram(bins, g, h, w, max_bin)
+        hist = root_histogram(bins, g, h, w, max_bin)
         return find_best_split(hist, root_g, root_h, root_c, num_bin, is_cat,
                                feat_mask, jnp.asarray(True), sp)
 
@@ -60,7 +60,7 @@ class SerialComm(NamedTuple):
                         totals_g, totals_h, totals_c, can,
                         num_bin, is_cat, feat_mask, max_bin: int,
                         sp: SplitParams):
-        hists = build_children_histograms(bins, g, h, w, leaf_id,
+        hists = children_histograms(bins, g, h, w, leaf_id,
                                           parent_leaf, right_leaf, max_bin)
         return find_best_split(hists, totals_g, totals_h, totals_c,
                                num_bin, is_cat, feat_mask, can, sp)

@@ -4,15 +4,15 @@ Replaces the reference's per-leaf gather + 4-way-unrolled scalar
 accumulation loop (dense_bin.hpp:65-133) with TPU-shaped formulations over
 the dense feature-major bin matrix:
 
-  * ``scatter``: one fused scatter-add keyed by (child, feature, bin) — a
-    single XLA scatter over all rows.  Because the pass is over the full
-    row set with masking, building BOTH children of a split in one pass
-    costs the same as building one, so the reference's smaller-child +
-    histogram-subtraction dance (serial_tree_learner.cpp:398-453) and the
-    LRU HistogramPool (feature_histogram.hpp:299-455) are unnecessary:
-    no per-leaf histogram state is kept at all.
-  * ``onehot``: block-wise one-hot matmul (MXU path), used where scatter
-    lowers poorly.
+  * ``scatter`` (CPU path): one fused scatter-add keyed by (child,
+    feature, bin) — a single XLA scatter over all rows.  Because the pass
+    is over the full row set with masking, building BOTH children of a
+    split in one pass costs the same as building one, so the reference's
+    smaller-child + histogram-subtraction dance (serial_tree_learner.cpp:
+    398-453) and the LRU HistogramPool (feature_histogram.hpp:299-455) are
+    unnecessary: no per-leaf histogram state is kept at all.
+  * Pallas MXU kernel (TPU path): see pallas_histogram.py; selected by the
+    ``children_histograms`` / ``root_histogram`` dispatchers below.
 
 Values accumulated per (feature, bin): (sum_gradients, sum_hessians, count)
 — HistogramBinEntry (bin.h:22-51).  Counts are bagging-mask sums.
@@ -24,6 +24,33 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:  # backend not initialised yet
+        return False
+
+
+def children_histograms(bins, grad, hess, weight, leaf_id,
+                        parent_leaf, right_leaf, max_bin: int):
+    """Platform dispatcher: Pallas MXU kernel on TPU (14x the XLA
+    scatter there), scatter-add elsewhere (CPU tests, small data)."""
+    if _on_tpu():
+        from .pallas_histogram import children_histograms_pallas
+        return children_histograms_pallas(bins, grad, hess, weight, leaf_id,
+                                          parent_leaf, right_leaf, max_bin)
+    return build_children_histograms(bins, grad, hess, weight, leaf_id,
+                                     parent_leaf, right_leaf, max_bin)
+
+
+def root_histogram(bins, grad, hess, weight, max_bin: int):
+    """Platform dispatcher for the root (all-rows) histogram."""
+    if _on_tpu():
+        from .pallas_histogram import root_histogram_pallas
+        return root_histogram_pallas(bins, grad, hess, weight, max_bin)
+    return build_root_histogram(bins, grad, hess, weight, max_bin)
 
 
 def histogram_scatter(bins, seg, num_seg: int, grad, hess, weight):
@@ -82,42 +109,3 @@ def build_root_histogram(bins, grad, hess, weight, max_bin: int):
     seg = feat * B + bins.astype(jnp.int32)
     flat = histogram_scatter(bins, seg, F * B, grad, hess, weight)
     return flat.reshape(F, B, 3)
-
-
-# ---------------------------------------------------------------------------
-# One-hot matmul variant: histogram as MXU work, blocked over rows so the
-# [rows_block, B] one-hot never materializes at full N.
-# ---------------------------------------------------------------------------
-def _onehot_block(bins_blk, vals_blk, max_bin: int):
-    # bins_blk: [F, Nb] int32; vals_blk: [Nb, 3] f32 (pre-masked)
-    onehot = jax.nn.one_hot(bins_blk, max_bin, dtype=jnp.float32)  # [F, Nb, B]
-    # HIGHEST keeps the MXU pass in f32 (bf16 rounding of gradients would
-    # leak ~1e-2 relative error into split gains).
-    return jnp.einsum("fnb,nc->fbc", onehot, vals_blk,
-                      precision=jax.lax.Precision.HIGHEST)
-
-
-def histogram_onehot(bins, grad, hess, weight, row_mask, max_bin: int,
-                     block: int = 4096):
-    """[F, B, 3] histogram via blocked one-hot matmuls (MXU path)."""
-    F, N = bins.shape
-    pad = (-N) % block
-    if pad:
-        bins = jnp.pad(bins, ((0, 0), (0, pad)))
-        grad = jnp.pad(grad, (0, pad))
-        hess = jnp.pad(hess, (0, pad))
-        weight = jnp.pad(weight, (0, pad))
-        row_mask = jnp.pad(row_mask, (0, pad))
-    nblk = bins.shape[1] // block
-    bins_b = bins.reshape(F, nblk, block).transpose(1, 0, 2).astype(jnp.int32)
-    w = weight * row_mask
-    vals = jnp.stack([grad * w, hess * w, w], axis=-1)       # [Npad, 3]
-    vals_b = vals.reshape(nblk, block, 3)
-
-    def body(acc, inp):
-        b_blk, v_blk = inp
-        return acc + _onehot_block(b_blk, v_blk, max_bin), None
-
-    init = jnp.zeros((F, max_bin, 3), dtype=jnp.float32)
-    acc, _ = jax.lax.scan(body, init, (bins_b, vals_b))
-    return acc

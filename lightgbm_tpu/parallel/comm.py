@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.histogram import build_children_histograms, build_root_histogram
+from ..ops.histogram import children_histograms, root_histogram
 from ..ops.split import (BestSplit, SplitParams, combine_gathered_splits,
                          find_best_split, leaf_split_gain, per_feature_scan)
 
@@ -117,7 +117,7 @@ class DataParallelComm(NamedTuple):
 
     def root_split(self, bins, g, h, w, root_g, root_h, root_c,
                    num_bin, is_cat, feat_mask, max_bin: int, sp: SplitParams):
-        hist = build_root_histogram(bins, g, h, w, max_bin)
+        hist = root_histogram(bins, g, h, w, max_bin)
         return self._split_from_hist(hist, root_g, root_h, root_c,
                                      jnp.asarray(True), num_bin, is_cat,
                                      feat_mask, sp)
@@ -126,7 +126,7 @@ class DataParallelComm(NamedTuple):
                         totals_g, totals_h, totals_c, can,
                         num_bin, is_cat, feat_mask, max_bin: int,
                         sp: SplitParams):
-        hists = build_children_histograms(bins, g, h, w, leaf_id,
+        hists = children_histograms(bins, g, h, w, leaf_id,
                                           parent_leaf, right_leaf, max_bin)
         return self._split_from_hist(hists, totals_g, totals_h, totals_c,
                                      can, num_bin, is_cat, feat_mask, sp)
@@ -164,7 +164,7 @@ class FeatureParallelComm(NamedTuple):
                    num_bin, is_cat, feat_mask, max_bin: int, sp: SplitParams):
         offset, nb, ic, fm = self._local_meta(num_bin, is_cat, feat_mask)
         bins_blk = lax.dynamic_slice_in_dim(bins, offset, self.f_block, axis=0)
-        hist = build_root_histogram(bins_blk, g, h, w, max_bin)
+        hist = root_histogram(bins_blk, g, h, w, max_bin)
         local = find_best_split(hist, root_g, root_h, root_c, nb, ic, fm,
                                 jnp.asarray(True), sp)
         local = _offset_features(local, offset)
@@ -176,7 +176,7 @@ class FeatureParallelComm(NamedTuple):
                         sp: SplitParams):
         offset, nb, ic, fm = self._local_meta(num_bin, is_cat, feat_mask)
         bins_blk = lax.dynamic_slice_in_dim(bins, offset, self.f_block, axis=0)
-        hists = build_children_histograms(bins_blk, g, h, w, leaf_id,
+        hists = children_histograms(bins_blk, g, h, w, leaf_id,
                                           parent_leaf, right_leaf, max_bin)
         local = find_best_split(hists, totals_g, totals_h, totals_c,
                                 nb, ic, fm, can, sp)
@@ -268,7 +268,7 @@ class VotingParallelComm(NamedTuple):
 
     def root_split(self, bins, g, h, w, root_g, root_h, root_c,
                    num_bin, is_cat, feat_mask, max_bin: int, sp: SplitParams):
-        hist = build_root_histogram(bins, g, h, w, max_bin)
+        hist = root_histogram(bins, g, h, w, max_bin)
         best = self._elect_and_split(
             hist[None], jnp.asarray([root_g]), jnp.asarray([root_h]),
             jnp.asarray([root_c]), jnp.asarray([True]),
@@ -279,7 +279,7 @@ class VotingParallelComm(NamedTuple):
                         totals_g, totals_h, totals_c, can,
                         num_bin, is_cat, feat_mask, max_bin: int,
                         sp: SplitParams):
-        hists = build_children_histograms(bins, g, h, w, leaf_id,
+        hists = children_histograms(bins, g, h, w, leaf_id,
                                           parent_leaf, right_leaf, max_bin)
         return self._elect_and_split(hists, totals_g, totals_h, totals_c,
                                      can, num_bin, is_cat, feat_mask, sp)

@@ -8,7 +8,7 @@ import pytest
 
 from lightgbm_tpu.ops.grow import GrowParams, grow_tree
 from lightgbm_tpu.ops.split import SplitParams, find_best_split
-from lightgbm_tpu.ops.histogram import build_root_histogram, histogram_onehot
+from lightgbm_tpu.ops.histogram import build_root_histogram
 
 
 def _np_hist(bins, g, h, w, B):
@@ -77,18 +77,6 @@ def test_histogram_matches_numpy():
                                            jnp.asarray(h), jnp.asarray(w), 16))
     expected = _np_hist(bins, g, h, w, 16)
     np.testing.assert_allclose(hist, expected, rtol=1e-4, atol=1e-4)
-
-
-def test_histogram_onehot_matches_scatter():
-    bins, g, h = _make_data(n=1000)
-    w = np.ones_like(g)
-    a = np.asarray(build_root_histogram(jnp.asarray(bins), jnp.asarray(g),
-                                        jnp.asarray(h), jnp.asarray(w), 16))
-    b = np.asarray(histogram_onehot(jnp.asarray(bins), jnp.asarray(g),
-                                    jnp.asarray(h), jnp.asarray(w),
-                                    jnp.ones_like(jnp.asarray(g)), 16,
-                                    block=256))
-    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
 
 
 @pytest.mark.parametrize("seed", range(4))

@@ -132,6 +132,34 @@ def test_voting_parallel_small_topk_reasonable():
     assert np.isfinite(np.asarray(delta_p)).all()
 
 
+@pytest.mark.parametrize("learner", ["data", "feature", "voting"])
+def test_end_to_end_distributed_training_matches_serial(learner):
+    """Full GBDT training with a distributed tree_learner produces the same
+    model (all split decisions + leaf values) as serial training."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.models.gbdt import GBDT
+
+    rng = np.random.RandomState(7)
+    X = rng.normal(size=(600, 8))
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.1 * rng.normal(size=600) > 0)
+    base = {"objective": "binary", "num_leaves": 8, "max_bin": 32,
+            "min_data_in_leaf": 10, "min_sum_hessian_in_leaf": 1e-3,
+            "num_iterations": 5, "top_k": 8}
+    ds = BinnedDataset.from_matrix(X, y.astype(np.float32), max_bin=32,
+                                   min_data_in_leaf=10)
+    gb_s = GBDT(Config(dict(base)), ds)
+    gb_s.train(5)
+    gb_p = GBDT(Config(dict(base, tree_learner=learner, num_machines=8)), ds)
+    gb_p.train(5)
+    assert len(gb_s.models) == len(gb_p.models)
+    for ts, tp in zip(gb_s.models, gb_p.models):
+        assert ts.num_leaves == tp.num_leaves
+        np.testing.assert_array_equal(ts.split_feature, tp.split_feature)
+        np.testing.assert_allclose(ts.leaf_value, tp.leaf_value,
+                                   rtol=2e-4, atol=2e-6)
+
+
 def test_mesh_size_2_and_4():
     bins, g, h = _make_data(seed=6)
     ts, _, _ = _grow_serial(bins, g, h, PARAMS, 16)
