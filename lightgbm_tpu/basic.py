@@ -1,0 +1,503 @@
+"""User-facing Dataset / Booster API.
+
+Mirrors the reference python package's surface (python-package/lightgbm/
+basic.py): lazy Dataset construction with pandas/categorical handling
+(basic.py:224-267, 531-1150), reference-aligned validation sets
+(basic.py:792-819), and a Booster with train/eval/predict/save/load plus
+model-string pickling (basic.py:1155-1262).  The ctypes/C-API layer is
+replaced by direct calls into the JAX engine (models/gbdt.py).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config
+from .io.dataset import BinnedDataset, Metadata
+from .io.parser import parse_file
+from .models import create_boosting
+from .utils import log
+from .utils.log import LightGBMError
+
+
+def _to_dense(data):
+    """Accept numpy / pandas / scipy-sparse / list-of-lists."""
+    if hasattr(data, "toarray"):          # scipy CSR/CSC without importing it
+        data = data.toarray()
+    if hasattr(data, "values") and hasattr(data, "dtypes"):  # pandas
+        data = data.values
+    arr = np.asarray(data, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(1, -1)
+    return arr
+
+
+def _data_from_pandas(data, feature_name, categorical_feature):
+    """Pandas handling (reference _data_from_pandas, basic.py:224-267):
+    auto feature names from columns, categorical dtype -> codes."""
+    if not (hasattr(data, "dtypes") and hasattr(data, "columns")):
+        return data, feature_name, categorical_feature
+    df = data.copy()
+    if feature_name == "auto":
+        feature_name = [str(c) for c in df.columns]
+    cat_cols = [c for c in df.columns
+                if str(df[c].dtype) == "category"]
+    if categorical_feature == "auto":
+        categorical_feature = [str(c) for c in cat_cols]
+    for c in cat_cols:
+        df[c] = df[c].cat.codes.astype(np.float64)
+    return df.astype(np.float64).values, feature_name, categorical_feature
+
+
+class Dataset:
+    """Dataset in LightGBM-TPU (reference Dataset, basic.py:531).
+
+    Construction is lazy: binning happens on first use (construct()), so
+    parameters/fields set before training are honoured like the reference.
+    """
+
+    def __init__(self, data, label=None, max_bin=255, reference=None,
+                 weight=None, group=None, silent=False,
+                 feature_name="auto", categorical_feature="auto",
+                 params=None, free_raw_data=True):
+        self.data = data
+        self.label = label
+        self.max_bin = max_bin
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = None
+        self.silent = silent
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params) if params else {}
+        self.free_raw_data = free_raw_data
+        self.used_indices: Optional[np.ndarray] = None
+        self._binned: Optional[BinnedDataset] = None
+        self._predictor = None
+
+    # -- lazy construction ----------------------------------------------
+    def construct(self) -> "Dataset":
+        if self._binned is not None:
+            return self
+        if self.reference is not None:
+            ref = self.reference.construct()._binned
+        else:
+            ref = None
+
+        data = self.data
+        if isinstance(data, str):
+            label, X, header = parse_file(
+                data, has_header=bool(self.params.get("has_header", False)),
+                label_idx=int(self.params.get("label_column", 0)))
+            if self.label is None:
+                self.label = label
+            if header and self.feature_name == "auto":
+                self.feature_name = header
+            data = X
+        else:
+            data, self.feature_name, self.categorical_feature = \
+                _data_from_pandas(data, self.feature_name,
+                                  self.categorical_feature)
+            data = _to_dense(data)
+
+        feature_name = (None if self.feature_name == "auto"
+                        else list(self.feature_name))
+        cat = self.categorical_feature
+        if cat == "auto" or cat is None:
+            cat_idx: List[int] = []
+        else:
+            cat_idx = []
+            for c in cat:
+                if isinstance(c, str):
+                    if feature_name is None or c not in feature_name:
+                        raise LightGBMError(
+                            f"Unknown categorical feature name {c!r}")
+                    cat_idx.append(feature_name.index(c))
+                else:
+                    cat_idx.append(int(c))
+
+        if self.used_indices is not None:
+            # Subset of a constructed reference (reference subset(),
+            # basic.py:820-837)
+            base = self.reference.construct()._binned
+            self._binned = base.subset(self.used_indices)
+        elif ref is not None:
+            self._binned = ref.create_valid(data, self.label)
+        else:
+            cfg = Config({**self.params, "max_bin": self.max_bin,
+                          "task": "train"})
+            self._binned = BinnedDataset.from_matrix(
+                data, self.label,
+                max_bin=int(self.params.get("max_bin", self.max_bin)),
+                min_data_in_leaf=cfg.min_data_in_leaf,
+                min_data_in_bin=cfg.min_data_in_bin,
+                bin_construct_sample_cnt=cfg.bin_construct_sample_cnt,
+                categorical_features=cat_idx,
+                feature_names=feature_name,
+                data_random_seed=cfg.data_random_seed)
+        md = self._binned.metadata
+        if self.label is not None and self.used_indices is None:
+            md.set_label(np.asarray(self.label))
+        if self.weight is not None:
+            md.set_weights(np.asarray(self.weight))
+        if self.group is not None:
+            md.set_query(np.asarray(self.group))
+        if self.init_score is not None:
+            md.set_init_score(np.asarray(self.init_score))
+        if isinstance(self.data, str):
+            md.load_side_files(self.data)
+        if self._predictor is not None:
+            # continued training: init scores = prior model's raw predictions
+            # (reference _set_predictor flow, dataset_loader.cpp:10)
+            raw = np.asarray(self._predictor.predict(self.data if data is None
+                                                     else data,
+                                                     raw_score=True))
+            # class-major flatten for multiclass (score[k*num_data + i])
+            md.set_init_score(raw.reshape(-1, order="F"))
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    # -- setters (reference set_field wrappers) -------------------------
+    def set_label(self, label):
+        self.label = label
+        if self._binned is not None and label is not None:
+            self._binned.metadata.set_label(np.asarray(label))
+        return self
+
+    def set_weight(self, weight):
+        self.weight = weight
+        if self._binned is not None and weight is not None:
+            self._binned.metadata.set_weights(np.asarray(weight))
+        return self
+
+    def set_group(self, group):
+        self.group = group
+        if self._binned is not None and group is not None:
+            self._binned.metadata.set_query(np.asarray(group))
+        return self
+
+    def set_init_score(self, init_score):
+        self.init_score = init_score
+        if self._binned is not None and init_score is not None:
+            self._binned.metadata.set_init_score(np.asarray(init_score))
+        return self
+
+    def set_reference(self, reference):
+        if self._binned is not None:
+            raise LightGBMError("Cannot set reference after construction")
+        self.reference = reference
+        return self
+
+    def set_feature_name(self, feature_name):
+        self.feature_name = feature_name
+        if self._binned is not None and feature_name not in (None, "auto"):
+            self._binned.feature_names = list(feature_name)
+        return self
+
+    def set_categorical_feature(self, categorical_feature):
+        if self._binned is not None and \
+                categorical_feature != self.categorical_feature:
+            raise LightGBMError(
+                "Cannot set categorical feature after construction")
+        self.categorical_feature = categorical_feature
+        return self
+
+    def _update_params(self, params):
+        self.params.update(params)
+        return self
+
+    def _set_predictor(self, predictor):
+        if self._binned is not None and predictor is not None:
+            raise LightGBMError("Cannot set predictor after construction")
+        self._predictor = predictor
+        return self
+
+    # -- getters ---------------------------------------------------------
+    def get_label(self):
+        if self._binned is not None:
+            return self._binned.metadata.label
+        return self.label
+
+    def get_weight(self):
+        if self._binned is not None:
+            return self._binned.metadata.weights
+        return self.weight
+
+    def get_group(self):
+        if self._binned is not None and \
+                self._binned.metadata.query_boundaries is not None:
+            qb = self._binned.metadata.query_boundaries
+            return np.diff(qb)
+        return self.group
+
+    def get_init_score(self):
+        if self._binned is not None:
+            return self._binned.metadata.init_score
+        return self.init_score
+
+    def num_data(self) -> int:
+        return self.construct()._binned.num_data
+
+    def num_feature(self) -> int:
+        return self.construct()._binned.num_total_features
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        """Row subset sharing this dataset's bin mappers."""
+        sub = Dataset(None, reference=self,
+                      feature_name=self.feature_name,
+                      categorical_feature=self.categorical_feature,
+                      params=params or self.params)
+        sub.used_indices = np.asarray(used_indices)
+        return sub
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     silent=False, params=None) -> "Dataset":
+        """Validation Dataset aligned with this one (reference
+        create_valid, basic.py:792-819)."""
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, silent=silent, params=params)
+
+    def save_binary(self, filename) -> "Dataset":
+        self.construct()._binned.save_binary(filename)
+        return self
+
+
+class Booster:
+    """Booster in LightGBM-TPU (reference Booster, basic.py:1155)."""
+
+    def __init__(self, params=None, train_set=None, model_file=None,
+                 silent=False):
+        params = dict(params or {})
+        self.best_iteration = -1
+        self.__train_data_name = "training"
+        self.__attr: Dict[str, str] = {}
+        self._train_set: Optional[Dataset] = None
+        self._valid_sets: List[Dataset] = []
+        self._name_valid_sets: List[str] = []
+
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError("Training data should be Dataset instance, "
+                                f"met {type(train_set).__name__}")
+            train_set.construct()
+            self.config = Config({**train_set.params, **params})
+            self._booster = create_boosting(self.config, train_set._binned)
+            self._train_set = train_set
+        elif model_file is not None:
+            with open(model_file) as fh:
+                model_str = fh.read()
+            self.config = Config({**params, "task": "predict"})
+            self._booster = create_boosting(self.config, None,
+                                            model_str=model_str)
+            self.best_iteration = -1
+        else:
+            raise TypeError("At least one of train_set or model_file "
+                            "should be set")
+
+    # -- training --------------------------------------------------------
+    def set_train_data_name(self, name):
+        self.__train_data_name = name
+        return self
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        if not isinstance(data, Dataset):
+            raise TypeError("Validation data should be Dataset instance, "
+                            f"met {type(data).__name__}")
+        data.construct()
+        self._booster.add_valid_dataset(data._binned)
+        self._valid_sets.append(data)
+        self._name_valid_sets.append(name)
+        return self
+
+    def reset_parameter(self, params) -> "Booster":
+        """reset_parameter (basic.py:1291): rebuild config keeping state."""
+        self.config = Config({**self.config.raw_params(), **params})
+        self._booster.reset_config(self.config)
+        return self
+
+    def update(self, train_set=None, fobj=None) -> bool:
+        """One boosting iteration; returns True if no further splits
+        (reference update, basic.py:1310-1350)."""
+        if train_set is not None and train_set is not self._train_set:
+            raise LightGBMError("Replacing train_set is not supported; "
+                                "create a new Booster")
+        if fobj is None:
+            return self._booster.train_one_iter()
+        grad, hess = fobj(self.__inner_predict(0), self._train_set)
+        return self.__boost(grad, hess)
+
+    def __boost(self, grad, hess) -> bool:
+        grad = np.asarray(grad, np.float32)
+        hess = np.asarray(hess, np.float32)
+        n = self._booster.num_data * self._booster.num_class
+        if grad.size != n or hess.size != n:
+            raise ValueError(
+                f"Lengths of gradient({grad.size}) and hessian({hess.size}) "
+                f"don't match training data ({n})")
+        return self._booster.train_one_iter(grad, hess)
+
+    def rollback_one_iter(self) -> "Booster":
+        self._booster.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        return self._booster.iter_
+
+    # -- evaluation ------------------------------------------------------
+    def __inner_predict(self, data_idx: int) -> np.ndarray:
+        """Raw scores of train (0) or valid_i (i+1), flattened class-major
+        like the reference (basic.py:1689)."""
+        b = self._booster
+        dd = b.train_data if data_idx == 0 else b.valid_data[data_idx - 1]
+        return np.asarray(dd.score, np.float64).reshape(-1)
+
+    def __eval_at(self, data_idx: int, name: str, feval=None):
+        b = self._booster
+        out = []
+        metrics = (b.train_metrics if data_idx == 0
+                   else b.valid_metrics[data_idx - 1])
+        dd = b.train_data if data_idx == 0 else b.valid_data[data_idx - 1]
+        score = np.asarray(dd.score, np.float64)
+        for m in metrics:
+            for mname, v in zip(m.names, m.eval(score)):
+                out.append((name, mname, v, m.factor_to_bigger_better > 0))
+        if feval is not None:
+            ds = (self._train_set if data_idx == 0
+                  else self._valid_sets[data_idx - 1])
+            ret = feval(self.__inner_predict(data_idx), ds)
+            if isinstance(ret, list):
+                for fname, val, bigger in ret:
+                    out.append((name, fname, val, bigger))
+            elif ret is not None:
+                fname, val, bigger = ret
+                out.append((name, fname, val, bigger))
+        return out
+
+    def eval(self, data, name, feval=None):
+        for i, vs in enumerate(self._valid_sets):
+            if vs is data:
+                return self.__eval_at(i + 1, name, feval)
+        if data is self._train_set:
+            return self.eval_train(feval)
+        raise LightGBMError("Data should be either train or a valid set")
+
+    def eval_train(self, feval=None):
+        return self.__eval_at(0, self.__train_data_name, feval)
+
+    def eval_valid(self, feval=None):
+        out = []
+        for i, name in enumerate(self._name_valid_sets):
+            out.extend(self.__eval_at(i + 1, name, feval))
+        return out
+
+    # -- model I/O -------------------------------------------------------
+    def save_model(self, filename, num_iteration=-1) -> "Booster":
+        self._booster.save_model_to_file(filename, num_iteration)
+        return self
+
+    def model_to_string(self, num_iteration=-1) -> str:
+        return self._booster.save_model_to_string(num_iteration)
+
+    def dump_model(self, num_iteration=-1) -> dict:
+        """JSON-style dict dump (reference dump_model, basic.py:1522)."""
+        b = self._booster
+        n_models = len(b.models)
+        if num_iteration > 0:
+            n_models = min(n_models, num_iteration * b.num_class)
+        return {
+            "name": "tree",
+            "num_class": b.num_class,
+            "label_index": b.label_idx,
+            "max_feature_idx": b.max_feature_idx,
+            "feature_names": list(b.feature_names),
+            "tree_info": [b.models[i].to_json() for i in range(n_models)],
+        }
+
+    # -- prediction ------------------------------------------------------
+    def predict(self, data, num_iteration=-1, raw_score=False,
+                pred_leaf=False, data_has_header=False, is_reshape=True):
+        """Batch prediction (reference predict, basic.py:1560)."""
+        if isinstance(data, str):
+            _, X, _ = parse_file(data, has_header=data_has_header,
+                                 label_idx=self._booster.label_idx)
+        else:
+            data, _, _ = _data_from_pandas(data, "auto", "auto")
+            X = _to_dense(data)
+        b = self._booster
+        if pred_leaf:
+            return b.predict_leaf_index(X, num_iteration)
+        out = (b.predict_raw(X, num_iteration) if raw_score
+               else b.predict(X, num_iteration))
+        out = np.asarray(out)
+        if out.shape[0] == 1:
+            return out[0]
+        if is_reshape:
+            return out.T                      # [n, num_class]
+        return out.reshape(-1)
+
+    # -- introspection ---------------------------------------------------
+    def feature_name(self) -> List[str]:
+        return list(self._booster.feature_names)
+
+    def feature_importance(self, importance_type="split") -> np.ndarray:
+        b = self._booster
+        counts = np.zeros(b.max_feature_idx + 1, np.float64)
+        for tree in b.models:
+            nl = tree.num_leaves - 1
+            for i in range(nl):
+                f = tree.split_feature[i]
+                if importance_type == "split":
+                    counts[f] += 1
+                elif importance_type == "gain":
+                    counts[f] += tree.split_gain[i]
+        if importance_type == "split":
+            return counts.astype(np.int64)
+        return counts
+
+    def num_trees(self) -> int:
+        return self._booster.num_trees()
+
+    def attr(self, key):
+        return self.__attr.get(key)
+
+    def set_attr(self, **kwargs) -> "Booster":
+        for k, v in kwargs.items():
+            if v is None:
+                self.__attr.pop(k, None)
+            else:
+                self.__attr[k] = str(v)
+        return self
+
+    # -- pickling via model string (basic.py:1243-1262) ------------------
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_booster", None)
+        state.pop("_train_set", None)
+        state.pop("_valid_sets", None)
+        state["_model_str"] = self.model_to_string()
+        return state
+
+    def __setstate__(self, state):
+        model_str = state.pop("_model_str")
+        self.__dict__.update(state)
+        self._train_set = None
+        self._valid_sets = []
+        self.config = Config({"task": "predict"})
+        self._booster = create_boosting(self.config, None,
+                                        model_str=model_str)
+
+    def __copy__(self):
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, _):
+        new = Booster.__new__(Booster)
+        new.__setstate__(self.__getstate__())
+        return new
+
+    def _to_predictor(self) -> "Booster":
+        return self

@@ -1,0 +1,149 @@
+"""Training callbacks (reference python-package/lightgbm/callback.py).
+
+The protocol is identical: callbacks receive a ``CallbackEnv`` namedtuple
+(callback.py:24-31) before/after every iteration; ``before_iteration``
+attribute orders them; early stopping unwinds via EarlyStopException
+(callback.py:144-209).
+"""
+
+from __future__ import annotations
+
+import collections
+from .utils import log
+
+
+class EarlyStopException(Exception):
+    """Raised to stop training (callback.py:14-21)."""
+
+    def __init__(self, best_iteration):
+        super().__init__()
+        self.best_iteration = best_iteration
+
+
+CallbackEnv = collections.namedtuple(
+    "LightGBMCallbackEnv",
+    ["model", "params", "iteration", "begin_iteration", "end_iteration",
+     "evaluation_result_list"])
+
+
+def _format_eval_result(value, show_stdv=True):
+    """(callback.py:34-43)."""
+    if len(value) == 4:
+        return f"{value[0]}'s {value[1]}: {value[2]:g}"
+    if len(value) == 5:
+        if show_stdv:
+            return f"{value[0]}'s {value[1]}: {value[2]:g} + {value[4]:g}"
+        return f"{value[0]}'s {value[1]}: {value[2]:g}"
+    raise ValueError("Wrong metric value")
+
+
+def print_evaluation(period=1, show_stdv=True):
+    """Print evaluation results every ``period`` iterations
+    (callback.py:46-66)."""
+    def callback(env: CallbackEnv):
+        if period > 0 and env.evaluation_result_list \
+                and (env.iteration + 1) % period == 0:
+            result = "\t".join(_format_eval_result(x, show_stdv)
+                               for x in env.evaluation_result_list)
+            log.info("[%d]\t%s", env.iteration + 1, result)
+    callback.order = 10
+    return callback
+
+
+def record_evaluation(eval_result):
+    """Record evaluation history into ``eval_result`` (callback.py:69-97)."""
+    if not isinstance(eval_result, dict):
+        raise TypeError("eval_result has to be a dictionary")
+    eval_result.clear()
+
+    def init(env: CallbackEnv):
+        for data_name, eval_name, _, _ in env.evaluation_result_list:
+            eval_result.setdefault(data_name, collections.OrderedDict())
+            eval_result[data_name].setdefault(eval_name, [])
+
+    def callback(env: CallbackEnv):
+        if not eval_result:
+            init(env)
+        for data_name, eval_name, result, _ in env.evaluation_result_list:
+            eval_result.setdefault(data_name, collections.OrderedDict())
+            eval_result[data_name].setdefault(eval_name, [])
+            eval_result[data_name][eval_name].append(result)
+    callback.order = 20
+    return callback
+
+
+def reset_parameter(**kwargs):
+    """Reset parameters after the first iteration: value may be a list
+    (per-iteration) or a function of the iteration (callback.py:100-141).
+
+    Example: reset_parameter(learning_rate=lambda i: 0.1 * 0.99 ** i)
+    """
+    def callback(env: CallbackEnv):
+        new_parameters = {}
+        for key, value in kwargs.items():
+            if key in ("num_class", "boosting_type", "metric"):
+                raise RuntimeError(f"cannot reset {key} during training")
+            if isinstance(value, list):
+                if len(value) != env.end_iteration - env.begin_iteration:
+                    raise ValueError(
+                        f"Length of list {key!r} has to equal to "
+                        "'num_boost_round'.")
+                new_param = value[env.iteration - env.begin_iteration]
+            else:
+                new_param = value(env.iteration - env.begin_iteration)
+            if new_param != env.params.get(key, None):
+                new_parameters[key] = new_param
+        if new_parameters:
+            env.model.reset_parameter(new_parameters)
+            env.params.update(new_parameters)
+    callback.before_iteration = True
+    callback.order = 10
+    return callback
+
+
+def early_stopping(stopping_rounds, verbose=True):
+    """Early stopping over every (valid set, metric) pair
+    (callback.py:144-209)."""
+    best_score = []
+    best_iter = []
+    best_score_list = []
+    cmp_op = []
+
+    def init(env: CallbackEnv):
+        if not env.evaluation_result_list:
+            raise ValueError(
+                "For early stopping, at least one dataset and eval metric "
+                "is required for evaluation")
+        if verbose:
+            log.info("Train until valid scores didn't improve in %d rounds.",
+                     stopping_rounds)
+        for _ in env.evaluation_result_list:
+            best_iter.append(0)
+            best_score_list.append(None)
+        for _, _, _, greater_is_better in env.evaluation_result_list:
+            if greater_is_better:
+                best_score.append(float("-inf"))
+                cmp_op.append(lambda a, b: a > b)
+            else:
+                best_score.append(float("inf"))
+                cmp_op.append(lambda a, b: a < b)
+
+    def callback(env: CallbackEnv):
+        if not cmp_op:
+            init(env)
+        for i, (_, _, score, _) in enumerate(env.evaluation_result_list):
+            if cmp_op[i](score, best_score[i]):
+                best_score[i] = score
+                best_iter[i] = env.iteration
+                best_score_list[i] = env.evaluation_result_list
+            elif env.iteration - best_iter[i] >= stopping_rounds:
+                if env.model is not None:
+                    env.model.best_iteration = best_iter[i] + 1
+                if verbose:
+                    log.info("Early stopping, best iteration is:\n[%d]\t%s",
+                             best_iter[i] + 1,
+                             "\t".join(_format_eval_result(x)
+                                       for x in best_score_list[i]))
+                raise EarlyStopException(best_iter[i])
+    callback.order = 30
+    return callback

@@ -1,0 +1,105 @@
+"""Command-line application (reference src/application/ + src/main.cpp).
+
+Accepts the reference CLI's exact invocation style:
+
+    python -m lightgbm_tpu config=train.conf [key=value ...]
+
+Parameter precedence and parsing mirror Application::LoadParameters
+(application.cpp:46-104): later argv pairs win over config-file lines;
+'#' starts a comment; keys run through the alias table.  task=train loads
+data (+optional valid sets + side files), trains, and saves the model;
+task=predict loads input_model and writes predictions to output_result.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Dict, List
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import Config, parse_cli_args
+from .engine import train as engine_train
+from .utils import log
+
+
+def run_train(config: Config, params: Dict[str, str]) -> None:
+    """Application::InitTrain + Train (application.cpp:187-240)."""
+    data_path = config.data
+    if not data_path:
+        log.fatal("No training data specified (data=...)")
+    train_set = Dataset(data_path, params={**params})
+
+    valid_paths = config.valid_data if isinstance(config.valid_data, list) \
+        else ([config.valid_data] if config.valid_data else [])
+    valid_sets = []
+    valid_names = []
+    if config.is_training_metric:
+        valid_sets.append(train_set)
+        valid_names.append("training")
+    for i, path in enumerate(valid_paths):
+        valid_sets.append(train_set.create_valid(path))
+        valid_names.append(f"valid_{i + 1}")
+
+    num_rounds = config.num_iterations
+    start = time.time()
+    evals_result: Dict[str, dict] = {}
+    booster = engine_train(
+        dict(params), train_set, num_boost_round=num_rounds,
+        valid_sets=valid_sets or None, valid_names=valid_names or None,
+        verbose_eval=max(config.output_freq, 1),
+        early_stopping_rounds=(config.early_stopping_round
+                               if config.early_stopping_round > 0 else None),
+        evals_result=evals_result,
+        init_model=(config.input_model or None))
+    log.info("%f seconds elapsed, finished training", time.time() - start)
+    out = config.output_model or "LightGBM_model.txt"
+    booster.save_model(out)
+    log.info("Finished training. Model saved to %s", out)
+
+
+def run_predict(config: Config, params: Dict[str, str]) -> None:
+    """Application::Predict (application.cpp:243-257) via Predictor."""
+    if not config.input_model:
+        log.fatal("No model file specified (input_model=...)")
+    if not config.data:
+        log.fatal("No prediction data specified (data=...)")
+    booster = Booster(params=dict(params), model_file=config.input_model)
+    start = time.time()
+    out = booster.predict(config.data,
+                          raw_score=config.is_predict_raw_score,
+                          pred_leaf=config.is_predict_leaf_index,
+                          data_has_header=config.has_header)
+    result_path = config.output_result or "LightGBM_predict_result.txt"
+    arr = np.asarray(out)
+    with open(result_path, "w") as fh:
+        if arr.ndim == 1:
+            for v in arr:
+                fh.write(f"{v:g}\n")
+        else:
+            for row in arr:
+                fh.write("\t".join(f"{v:g}" for v in row) + "\n")
+    log.info("%f seconds elapsed, finished prediction", time.time() - start)
+    log.info("Finished prediction. Results saved to %s", result_path)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python -m lightgbm_tpu config=<conf> [key=value ...]")
+        return 1
+    params = parse_cli_args(argv)
+    config = Config(params)
+    if config.task == "train":
+        run_train(config, params)
+    elif config.task in ("predict", "prediction", "test"):
+        run_predict(config, params)
+    else:
+        log.fatal("Unknown task type %s", config.task)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -278,6 +278,10 @@ class Config:
             self._values[key] = self._coerce(key, value)
         self._check_param_conflict()
 
+    def raw_params(self) -> Dict[str, Any]:
+        """The user-supplied (alias-resolved) parameter dict."""
+        return dict(self.raw)
+
     @staticmethod
     def _coerce(key: str, value: Any) -> Any:
         if key in _LIST_KEYS:
@@ -328,14 +332,17 @@ class Config:
                 raise ValueError(
                     "Number of classes should be specified and greater than 2 "
                     "for multiclass training")
+        elif obj == "none":
+            pass  # custom objective (python fobj): any num_class allowed
         else:
             if v["num_class"] != 1 and v["task"] == "train":
                 raise ValueError("Number of classes must be 1 for non-multiclass training")
         # Objective/metric compatibility (config.cpp:152-160).
-        for metric in v["metric"]:
-            metric_multiclass = metric in ("multi_logloss", "multi_error")
-            if (obj == "multiclass") != metric_multiclass:
-                raise ValueError("Objective and metrics don't match")
+        if obj != "none":
+            for metric in v["metric"]:
+                metric_multiclass = metric in ("multi_logloss", "multi_error")
+                if (obj == "multiclass") != metric_multiclass:
+                    raise ValueError("Objective and metrics don't match")
         if v["boosting_type"] == "goss" and (
             v["bagging_fraction"] < 1.0 and v["bagging_freq"] > 0
         ):

@@ -1,0 +1,288 @@
+"""Training and cross-validation entry points (reference engine.py).
+
+train() (engine.py:17-203): callback-driven boosting loop with valid sets,
+custom fobj/feval, continued training from init_model, per-iteration
+learning rates, early stopping, evals_result capture.
+
+cv() (engine.py:204-416): n-fold (optionally stratified) cross validation
+aggregating mean/std per metric through a CVBooster.
+"""
+
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import callback
+from .basic import Booster, Dataset
+from .utils import log
+from .utils.log import LightGBMError
+
+
+def train(params, train_set, num_boost_round=100,
+          valid_sets=None, valid_names=None,
+          fobj=None, feval=None, init_model=None,
+          feature_name="auto", categorical_feature="auto",
+          early_stopping_rounds=None, evals_result=None,
+          verbose_eval=True, learning_rates=None, callbacks=None):
+    """Train with given parameters; returns a Booster."""
+    params = dict(params or {})
+    if fobj is not None:
+        params["objective"] = "none"
+    for alias in ("num_boost_round", "num_iterations", "num_iteration",
+                  "num_tree", "num_trees", "num_round", "num_rounds"):
+        if alias in params:
+            num_boost_round = int(params.pop(alias))
+            break
+
+    # continued training setup (engine.py:94-112)
+    predictor = None
+    if isinstance(init_model, str):
+        predictor = Booster(model_file=init_model)
+    elif isinstance(init_model, Booster):
+        predictor = init_model._to_predictor()
+    init_iteration = predictor.current_iteration() if predictor is not None \
+        and hasattr(predictor, "current_iteration") else 0
+    if predictor is not None:
+        init_iteration = predictor._booster.num_init_iteration or \
+            len(predictor._booster.models) // max(
+                predictor._booster.num_class, 1)
+
+    if not isinstance(train_set, Dataset):
+        raise TypeError("Training only accepts Dataset object")
+    train_set._update_params(params) \
+             ._set_predictor(predictor) \
+             .set_feature_name(feature_name) \
+             .set_categorical_feature(categorical_feature)
+
+    booster = Booster(params=params, train_set=train_set)
+    if predictor is not None:
+        # bring forward the previous model's trees (GBDT::MergeFrom role)
+        booster._booster.models = list(predictor._booster.models) + \
+            booster._booster.models
+        booster._booster.num_init_iteration = init_iteration
+        booster._booster.iter_ = init_iteration
+
+    is_valid_contain_train = False
+    train_data_name = "training"
+    reduced_valid_sets = []
+    name_valid_sets = []
+    if valid_sets is not None:
+        if isinstance(valid_sets, Dataset):
+            valid_sets = [valid_sets]
+        if isinstance(valid_names, str):
+            valid_names = [valid_names]
+        for i, valid_data in enumerate(valid_sets):
+            if valid_data is train_set:
+                is_valid_contain_train = True
+                if valid_names is not None:
+                    train_data_name = valid_names[i]
+                continue
+            if not isinstance(valid_data, Dataset):
+                raise TypeError("Validation data should be Dataset instance")
+            valid_data._update_params(params)
+            reduced_valid_sets.append(valid_data)
+            name_valid_sets.append(valid_names[i] if valid_names is not None
+                                   else f"valid_{i}")
+    booster.set_train_data_name(train_data_name)
+    for vs, name in zip(reduced_valid_sets, name_valid_sets):
+        booster.add_valid(vs, name)
+
+    # callbacks (engine.py:113-142)
+    cbs = set(callbacks or [])
+    if verbose_eval is True:
+        cbs.add(callback.print_evaluation())
+    elif isinstance(verbose_eval, int) and verbose_eval is not False:
+        cbs.add(callback.print_evaluation(verbose_eval))
+    if early_stopping_rounds is not None:
+        cbs.add(callback.early_stopping(early_stopping_rounds,
+                                        verbose=bool(verbose_eval)))
+    if learning_rates is not None:
+        cbs.add(callback.reset_parameter(learning_rate=learning_rates))
+    if evals_result is not None:
+        cbs.add(callback.record_evaluation(evals_result))
+    callbacks_before = {cb for cb in cbs
+                        if getattr(cb, "before_iteration", False)}
+    callbacks_after = cbs - callbacks_before
+    callbacks_before = sorted(callbacks_before,
+                              key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after = sorted(callbacks_after,
+                             key=lambda cb: getattr(cb, "order", 0))
+
+    # boosting loop (engine.py:143-203)
+    for i in range(init_iteration, init_iteration + num_boost_round):
+        for cb in callbacks_before:
+            cb(callback.CallbackEnv(model=booster, params=params,
+                                    iteration=i,
+                                    begin_iteration=init_iteration,
+                                    end_iteration=init_iteration
+                                    + num_boost_round,
+                                    evaluation_result_list=None))
+        booster.update(fobj=fobj)
+
+        evaluation_result_list = []
+        if is_valid_contain_train:
+            evaluation_result_list.extend(booster.eval_train(feval))
+        if reduced_valid_sets:
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in callbacks_after:
+                cb(callback.CallbackEnv(
+                    model=booster, params=params, iteration=i,
+                    begin_iteration=init_iteration,
+                    end_iteration=init_iteration + num_boost_round,
+                    evaluation_result_list=evaluation_result_list))
+        except callback.EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            break
+    return booster
+
+
+class CVBooster:
+    """Auxiliary data struct holding all fold boosters (engine.py:204-240)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def append(self, booster):
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, data_splitter, nfold, params, seed,
+                  fpreproc=None, stratified=False, shuffle=True):
+    """Fold construction (engine.py:242-276)."""
+    full_data.construct()
+    num_data = full_data.num_data()
+    if data_splitter is not None:
+        if not hasattr(data_splitter, "split"):
+            raise AttributeError("data_splitter has no method 'split'")
+        folds = data_splitter.split(np.arange(num_data))
+    elif stratified:
+        label = np.asarray(full_data.get_label())
+        classes, y = np.unique(label, return_inverse=True)
+        rng = np.random.RandomState(seed)
+        fold_id = np.zeros(num_data, np.int64)
+        for c in range(len(classes)):
+            idx = np.where(y == c)[0]
+            if shuffle:
+                rng.shuffle(idx)
+            fold_id[idx] = np.arange(len(idx)) % nfold
+        folds = [(np.where(fold_id != k)[0], np.where(fold_id == k)[0])
+                 for k in range(nfold)]
+    else:
+        if shuffle:
+            randidx = np.random.RandomState(seed).permutation(num_data)
+        else:
+            randidx = np.arange(num_data)
+        kstep = int(num_data / nfold)
+        test_id = [randidx[i::nfold] for i in range(nfold)]
+        folds = [(np.setdiff1d(randidx, test_id[k], assume_unique=False),
+                  test_id[k]) for k in range(nfold)]
+
+    ret = CVBooster()
+    for train_idx, test_idx in folds:
+        train_subset = full_data.subset(np.sort(train_idx))
+        valid_subset = full_data.subset(np.sort(test_idx))
+        if fpreproc is not None:
+            train_subset, valid_subset, tparam = fpreproc(
+                train_subset, valid_subset, params.copy())
+        else:
+            tparam = params
+        cvbooster = Booster(tparam, train_subset)
+        cvbooster.add_valid(valid_subset, "valid")
+        ret.append(cvbooster)
+    return ret
+
+
+def _agg_cv_result(raw_results):
+    """Aggregate per-fold eval results (engine.py:278-290)."""
+    cvmap = collections.OrderedDict()
+    metric_type = {}
+    for one_result in raw_results:
+        for one_line in one_result:
+            key = f"{one_line[0]} {one_line[1]}"
+            metric_type[key] = one_line[3]
+            cvmap.setdefault(key, [])
+            cvmap[key].append(one_line[2])
+    return [("cv_agg", k, float(np.mean(v)), metric_type[k],
+             float(np.std(v))) for k, v in cvmap.items()]
+
+
+def cv(params, train_set, num_boost_round=10,
+       data_splitter=None, nfold=5, stratified=False, shuffle=True,
+       metrics=None, fobj=None, feval=None, init_model=None,
+       feature_name="auto", categorical_feature="auto",
+       early_stopping_rounds=None, fpreproc=None,
+       verbose_eval=None, show_stdv=True, seed=0, callbacks=None):
+    """Cross-validation; returns {metric-name: [mean...], -stdv: [...]}."""
+    if not isinstance(train_set, Dataset):
+        raise TypeError("Training only accepts Dataset object")
+    params = dict(params or {})
+    if fobj is not None:
+        params["objective"] = "none"
+    for alias in ("num_boost_round", "num_iterations", "num_iteration",
+                  "num_tree", "num_trees", "num_round", "num_rounds"):
+        if alias in params:
+            num_boost_round = int(params.pop(alias))
+            break
+    if metrics is not None:
+        params["metric"] = metrics
+    train_set._update_params(params) \
+             .set_feature_name(feature_name) \
+             .set_categorical_feature(categorical_feature)
+
+    results = collections.defaultdict(list)
+    cvfolds = _make_n_folds(train_set, data_splitter, nfold, params, seed,
+                            fpreproc=fpreproc, stratified=stratified,
+                            shuffle=shuffle)
+
+    cbs = set(callbacks or [])
+    if early_stopping_rounds is not None:
+        cbs.add(callback.early_stopping(early_stopping_rounds,
+                                        verbose=False))
+    if verbose_eval is True:
+        cbs.add(callback.print_evaluation(show_stdv=show_stdv))
+    elif isinstance(verbose_eval, int):
+        cbs.add(callback.print_evaluation(verbose_eval, show_stdv=show_stdv))
+    callbacks_before = {cb for cb in cbs
+                        if getattr(cb, "before_iteration", False)}
+    callbacks_after = cbs - callbacks_before
+    callbacks_before = sorted(callbacks_before,
+                              key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after = sorted(callbacks_after,
+                             key=lambda cb: getattr(cb, "order", 0))
+
+    for i in range(num_boost_round):
+        for cb in callbacks_before:
+            cb(callback.CallbackEnv(model=cvfolds, params=params,
+                                    iteration=i, begin_iteration=0,
+                                    end_iteration=num_boost_round,
+                                    evaluation_result_list=None))
+        for fold in cvfolds.boosters:
+            fold.update(fobj=fobj)
+        res = _agg_cv_result([fold.eval_valid(feval)
+                              for fold in cvfolds.boosters])
+        for _, key, mean, _, std in res:
+            results[key + "-mean"].append(mean)
+            results[key + "-stdv"].append(std)
+        try:
+            for cb in callbacks_after:
+                cb(callback.CallbackEnv(model=cvfolds, params=params,
+                                        iteration=i, begin_iteration=0,
+                                        end_iteration=num_boost_round,
+                                        evaluation_result_list=res))
+        except callback.EarlyStopException as e:
+            cvfolds.best_iteration = e.best_iteration + 1
+            for k in results:
+                results[k] = results[k][:cvfolds.best_iteration]
+            break
+    return dict(results)

@@ -84,30 +84,39 @@ class GBDT:
         self.num_bin = jnp.asarray(train_set.num_bin_per_feature())
         self.is_cat = jnp.asarray(train_set.is_categorical_per_feature())
         self.max_bin = cfg.max_bin
-        self.grow_params = GrowParams(
-            num_leaves=cfg.num_leaves, max_bin=cfg.max_bin,
-            min_data_in_leaf=cfg.min_data_in_leaf,
-            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
-            lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
-            min_gain_to_split=cfg.min_gain_to_split,
-            max_depth=cfg.max_depth)
+        self.grow_params = self._make_grow_params(cfg)
         self.shrinkage_rate = cfg.learning_rate
 
         self.train_data = _DeviceData(train_set, self.num_class)
         self.valid_data: List[_DeviceData] = []
         self.valid_metrics: List[List[Metric]] = []
-        self.train_metrics: List[Metric] = []
-        for name in cfg.metric:
-            m = create_metric(name, cfg)
-            if m is not None:
-                m.init(train_set.metadata, train_set.num_data)
-                self.train_metrics.append(m)
+        self.train_metrics = self._make_metrics(cfg, train_set)
 
         self._bagging_rng = np.random.RandomState(cfg.bagging_seed)
         self._feature_rng = np.random.RandomState(cfg.feature_fraction_seed)
         self._row_weight = jnp.ones(self.num_data, jnp.float32)
         self._grad_fn = jax.jit(self.objective.gradients)
         self._grow_fn = self._make_grow_fn()
+
+    @staticmethod
+    def _make_grow_params(cfg: Config) -> GrowParams:
+        return GrowParams(
+            num_leaves=cfg.num_leaves, max_bin=cfg.max_bin,
+            min_data_in_leaf=cfg.min_data_in_leaf,
+            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+            lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
+            min_gain_to_split=cfg.min_gain_to_split,
+            max_depth=cfg.max_depth)
+
+    @staticmethod
+    def _make_metrics(cfg: Config, dataset: BinnedDataset) -> List[Metric]:
+        out = []
+        for name in cfg.metric:
+            m = create_metric(name, cfg)
+            if m is not None:
+                m.init(dataset.metadata, dataset.num_data)
+                out.append(m)
+        return out
 
     def _make_grow_fn(self):
         """Pick the tree learner (TreeLearner::CreateTreeLearner,
@@ -132,6 +141,28 @@ class GBDT:
                         cfg.tree_learner, ndev)
         params = self.grow_params
         return lambda *args: grow_tree(*args, params)
+
+    def reset_config(self, config: Config) -> None:
+        """Booster::ResetConfig (c_api.cpp:96-134): re-derive learner
+        parameters and metrics against the existing training data (used by
+        the reset_parameter callback, e.g. learning-rate schedules)."""
+        old_cfg, self.config = getattr(self, "config", None), config
+        if not hasattr(self, "train_set"):
+            return
+        self.shrinkage_rate = config.learning_rate
+        new_params = self._make_grow_params(config)
+        if new_params != self.grow_params or (
+                old_cfg is not None
+                and old_cfg.tree_learner != config.tree_learner):
+            # Rebuild only when the jitted growth program actually changes:
+            # a fresh closure would force an XLA recompile every iteration
+            # under reset_parameter schedules (learning_rate is a runtime
+            # argument, not part of the compiled program).
+            self.grow_params = new_params
+            self._grow_fn = self._make_grow_fn()
+        self.train_metrics = self._make_metrics(config, self.train_set)
+        for vi, dd in enumerate(self.valid_data):
+            self.valid_metrics[vi] = self._make_metrics(config, dd.dataset)
 
     def add_valid_dataset(self, valid_set: BinnedDataset) -> None:
         """GBDT::AddValidDataset (gbdt.cpp:169-199)."""

@@ -353,6 +353,33 @@ _OBJECTIVES = {
 }
 
 
+class NoneObjective(ObjectiveFunction):
+    """Placeholder for python-side custom objectives (fobj): gradients come
+    from the user callback via Booster.update(fobj=...); this only carries
+    num_tree_per_iteration and an identity output transform (the reference
+    trains with a NULL objective through LGBM_BoosterUpdateOneIterCustom,
+    c_api.h:372-388)."""
+
+    name = "none"
+
+    def __init__(self, config=None):
+        self.num_class = getattr(config, "num_class", 1) if config else 1
+        self.num_tree_per_iteration = max(self.num_class, 1)
+
+    def init(self, metadata, num_data):
+        pass
+
+    def gradients(self, score):
+        raise RuntimeError(
+            "objective=none requires a custom fobj passed to train()/update()")
+
+    def convert_output(self, score):
+        return score
+
+
+_OBJECTIVES["none"] = NoneObjective
+
+
 def create_objective(config) -> ObjectiveFunction:
     """Factory (objective_function.cpp:9-29)."""
     name = config.objective

@@ -29,8 +29,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _hist_kernel(parent_ref, right_ref, bins_ref, g_ref, h_ref, w_ref,
-                 leaf_ref, out_ref, acc_ref, *, max_bin, f_blk, n_blk,
-                 num_features):
+                 leaf_ref, out_ref, acc_ref, *, max_bin, f_blk, n_blk):
     """Grid: (row_blocks,).  Accumulates [2, F, B, 3] into acc (VMEM)."""
     i = pl.program_id(0)
 
@@ -94,8 +93,7 @@ def children_histograms_pallas(bins, grad, hess, weight, leaf_id,
     bins = bins.astype(jnp.int32)
     grid = (nblocks,)
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, max_bin=B, f_blk=F, n_blk=n_blk,
-                          num_features=F),
+        functools.partial(_hist_kernel, max_bin=B, f_blk=F, n_blk=n_blk),
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),          # parent

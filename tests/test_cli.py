@@ -1,0 +1,80 @@
+"""CLI application tests: the reference's examples/*/train.conf must run
+unmodified (SURVEY.md §7 step 5), in-process via lightgbm_tpu.cli.main."""
+
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import cli
+
+REF_EXAMPLES = "/root/reference/examples"
+
+
+def _run_in(tmp_path, conf_dir, conf, extra=()):
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        return cli.main([f"config={os.path.join(conf_dir, conf)}", *extra])
+    finally:
+        os.chdir(cwd)
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_EXAMPLES),
+                    reason="reference examples not mounted")
+def test_regression_conf_train_and_predict(tmp_path):
+    conf_dir = os.path.join(REF_EXAMPLES, "regression")
+    rc = _run_in(tmp_path, conf_dir, "train.conf",
+                 [f"data={conf_dir}/regression.train",
+                  f"valid_data={conf_dir}/regression.test",
+                  "num_trees=5"])
+    assert rc == 0
+    model = tmp_path / "LightGBM_model.txt"
+    assert model.exists()
+    text = model.read_text()
+    assert text.startswith("gbdt") or text.startswith("tree")
+    assert "Tree=0" in text
+
+    rc = _run_in(tmp_path, conf_dir, "predict.conf",
+                 [f"data={conf_dir}/regression.test",
+                  f"input_model={model}"])
+    assert rc == 0
+    out = np.loadtxt(tmp_path / "LightGBM_predict_result.txt")
+    assert out.shape[0] == 500
+    assert np.isfinite(out).all()
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_EXAMPLES),
+                    reason="reference examples not mounted")
+def test_binary_conf_with_weights(tmp_path):
+    conf_dir = os.path.join(REF_EXAMPLES, "binary_classification")
+    rc = _run_in(tmp_path, conf_dir, "train.conf",
+                 [f"data={conf_dir}/binary.train",
+                  f"valid_data={conf_dir}/binary.test",
+                  "num_trees=5"])
+    assert rc == 0
+    assert (tmp_path / "LightGBM_model.txt").exists()
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_EXAMPLES),
+                    reason="reference examples not mounted")
+def test_lambdarank_conf_with_query(tmp_path):
+    conf_dir = os.path.join(REF_EXAMPLES, "lambdarank")
+    rc = _run_in(tmp_path, conf_dir, "train.conf",
+                 [f"data={conf_dir}/rank.train",
+                  f"valid_data={conf_dir}/rank.test",
+                  "num_trees=5"])
+    assert rc == 0
+    assert (tmp_path / "LightGBM_model.txt").exists()
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_EXAMPLES),
+                    reason="reference examples not mounted")
+def test_multiclass_conf(tmp_path):
+    conf_dir = os.path.join(REF_EXAMPLES, "multiclass_classification")
+    rc = _run_in(tmp_path, conf_dir, "train.conf",
+                 [f"data={conf_dir}/multiclass.train",
+                  f"valid_data={conf_dir}/multiclass.test",
+                  "num_trees=5"])
+    assert rc == 0
+    assert (tmp_path / "LightGBM_model.txt").exists()

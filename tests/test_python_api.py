@@ -1,0 +1,249 @@
+"""Python API surface tests, modeled on the reference's
+tests/python_package_test/{test_engine,test_sklearn,test_basic}.py:
+train/cv/callbacks/early stopping/custom objectives/save-load/pickle and
+the sklearn wrappers, against synthetic data with quality thresholds."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _binary_data(n=1200, f=10, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    logit = X[:, 0] + 0.8 * X[:, 1] * X[:, 2] - 0.5 * X[:, 3]
+    y = (logit + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+    return X, y
+
+
+def _regression_data(n=1200, f=8, seed=4):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] * 2.0 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=n)
+    return X, y
+
+
+PARAMS = {"num_leaves": 15, "min_data_in_leaf": 10,
+          "min_sum_hessian_in_leaf": 1e-3, "verbose": 1}
+
+
+def test_train_binary_with_valid_and_early_stopping():
+    X, y = _binary_data()
+    ds = lgb.Dataset(X[:800], y[:800], params=PARAMS)
+    vs = ds.create_valid(X[800:], y[800:])
+    evals_result = {}
+    booster = lgb.train({**PARAMS, "objective": "binary",
+                         "metric": ["binary_logloss", "auc"]},
+                        ds, num_boost_round=50, valid_sets=[vs],
+                        early_stopping_rounds=10,
+                        evals_result=evals_result, verbose_eval=False)
+    assert "valid_0" in evals_result
+    assert evals_result["valid_0"]["binary_logloss"][-1] < 0.5
+    assert booster.current_iteration() >= 10
+    pred = booster.predict(X[800:])
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y[800:], pred) > 0.85
+
+
+def test_train_regression_quality():
+    X, y = _regression_data()
+    ds = lgb.Dataset(X[:800], y[:800], params=PARAMS)
+    booster = lgb.train({**PARAMS, "objective": "regression"},
+                        ds, num_boost_round=60, verbose_eval=False)
+    pred = booster.predict(X[800:])
+    mse = float(np.mean((pred - y[800:]) ** 2))
+    assert mse < 0.6, mse
+
+
+def test_continued_training_init_model(tmp_path):
+    X, y = _regression_data()
+    ds = lgb.Dataset(X, y, params=PARAMS)
+    b1 = lgb.train({**PARAMS, "objective": "regression"}, ds,
+                   num_boost_round=10, verbose_eval=False)
+    path = str(tmp_path / "model.txt")
+    b1.save_model(path)
+    ds2 = lgb.Dataset(X, y, params=PARAMS)
+    b2 = lgb.train({**PARAMS, "objective": "regression"}, ds2,
+                   num_boost_round=10, init_model=path, verbose_eval=False)
+    assert b2.num_trees() == 20
+    mse1 = float(np.mean((b1.predict(X) - y) ** 2))
+    mse2 = float(np.mean((b2.predict(X) - y) ** 2))
+    assert mse2 < mse1
+
+
+def test_custom_objective_fobj():
+    X, y = _regression_data()
+    ds = lgb.Dataset(X, y, params=PARAMS)
+
+    def l2_fobj(preds, dataset):
+        grad = preds - np.asarray(dataset.get_label())
+        hess = np.ones_like(grad)
+        return grad, hess
+
+    booster = lgb.train({**PARAMS, "objective": "regression"}, ds,
+                        num_boost_round=30, fobj=l2_fobj,
+                        verbose_eval=False)
+    mse = float(np.mean((booster.predict(X) - y) ** 2))
+    assert mse < 0.6
+
+
+def test_feval_and_record():
+    X, y = _regression_data()
+    ds = lgb.Dataset(X[:800], y[:800], params=PARAMS)
+    vs = ds.create_valid(X[800:], y[800:])
+
+    def mae(preds, dataset):
+        return ("my_mae",
+                float(np.mean(np.abs(preds
+                                     - np.asarray(dataset.get_label())))),
+                False)
+
+    res = {}
+    lgb.train({**PARAMS, "objective": "regression"}, ds,
+              num_boost_round=15, valid_sets=[vs], feval=mae,
+              evals_result=res, verbose_eval=False)
+    assert "my_mae" in res["valid_0"]
+    assert res["valid_0"]["my_mae"][-1] < res["valid_0"]["my_mae"][0]
+
+
+def test_learning_rate_schedule():
+    X, y = _regression_data(400)
+    ds = lgb.Dataset(X, y, params=PARAMS)
+    booster = lgb.train({**PARAMS, "objective": "regression"}, ds,
+                        num_boost_round=5,
+                        learning_rates=lambda i: 0.2 * (0.5 ** i),
+                        verbose_eval=False)
+    assert booster.num_trees() == 5
+
+
+def test_cv():
+    X, y = _binary_data(600)
+    ds = lgb.Dataset(X, y, params=PARAMS)
+    res = lgb.cv({**PARAMS, "objective": "binary",
+                  "metric": "binary_logloss"}, ds,
+                 num_boost_round=8, nfold=3, stratified=True,
+                 verbose_eval=False)
+    key = "valid binary_logloss-mean"
+    assert key in res
+    assert len(res[key]) == 8
+    assert res[key][-1] < res[key][0]
+
+
+def test_save_load_predict_equal(tmp_path):
+    X, y = _binary_data(600)
+    ds = lgb.Dataset(X, y, params=PARAMS)
+    b = lgb.train({**PARAMS, "objective": "binary"}, ds,
+                  num_boost_round=8, verbose_eval=False)
+    p1 = b.predict(X)
+    path = str(tmp_path / "m.txt")
+    b.save_model(path)
+    b2 = lgb.Booster(model_file=path)
+    p2 = b2.predict(X)
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+    # pickle round trip (reference test_engine.py:136-156)
+    b3 = pickle.loads(pickle.dumps(b))
+    np.testing.assert_allclose(p1, b3.predict(X), rtol=1e-5, atol=1e-6)
+
+
+def test_dump_model_json():
+    X, y = _binary_data(400)
+    ds = lgb.Dataset(X, y, params=PARAMS)
+    b = lgb.train({**PARAMS, "objective": "binary"}, ds,
+                  num_boost_round=3, verbose_eval=False)
+    dumped = b.dump_model()
+    assert dumped["num_class"] == 1
+    assert len(dumped["tree_info"]) == 3
+
+
+def test_sklearn_regressor():
+    X, y = _regression_data()
+    model = lgb.LGBMRegressor(n_estimators=40, num_leaves=15,
+                              min_child_samples=10, min_child_weight=1e-3)
+    model.fit(X[:800], y[:800], verbose=False)
+    mse = float(np.mean((model.predict(X[800:]) - y[800:]) ** 2))
+    assert mse < 0.7
+    assert model.feature_importances_.sum() > 0
+
+
+def test_sklearn_classifier_binary_and_proba():
+    X, y = _binary_data()
+    ylab = np.where(y > 0, "pos", "neg")
+    model = lgb.LGBMClassifier(n_estimators=30, num_leaves=15,
+                               min_child_samples=10, min_child_weight=1e-3)
+    model.fit(X[:800], ylab[:800], verbose=False)
+    pred = model.predict(X[800:])
+    acc = float(np.mean(pred == ylab[800:]))
+    assert acc > 0.85, acc
+    proba = model.predict_proba(X[800:])
+    assert proba.shape == (400, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-6)
+
+
+def test_sklearn_classifier_multiclass():
+    rng = np.random.RandomState(5)
+    X = rng.normal(size=(900, 6))
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    model = lgb.LGBMClassifier(n_estimators=20, num_leaves=15,
+                               min_child_samples=10, min_child_weight=1e-3)
+    model.fit(X[:700], y[:700], verbose=False)
+    acc = float(np.mean(model.predict(X[700:]) == y[700:]))
+    assert acc > 0.8, acc
+
+
+def test_sklearn_custom_objective():
+    X, y = _regression_data()
+
+    def objective_ls(y_true, y_pred):
+        grad = y_pred - y_true
+        hess = np.ones_like(y_true)
+        return grad, hess
+
+    model = lgb.LGBMRegressor(n_estimators=30, num_leaves=15,
+                              objective=objective_ls,
+                              min_child_samples=10, min_child_weight=1e-3)
+    model.fit(X[:800], y[:800], verbose=False)
+    mse = float(np.mean((model.predict(X[800:]) - y[800:]) ** 2))
+    assert mse < 0.8
+
+
+def test_sklearn_ranker():
+    rng = np.random.RandomState(6)
+    n_q, q_size = 40, 20
+    n = n_q * q_size
+    X = rng.normal(size=(n, 5))
+    rel = np.clip((X[:, 0] + 0.5 * rng.normal(size=n)) > 0.5, 0, 4)
+    y = rel.astype(int)
+    group = np.full(n_q, q_size)
+    model = lgb.LGBMRanker(n_estimators=10, num_leaves=7,
+                           min_child_samples=5, min_child_weight=1e-3)
+    model.fit(X, y, group=group, verbose=False)
+    assert model.booster_.num_trees() == 10
+
+
+def test_sklearn_grid_search_compatible():
+    from sklearn.model_selection import GridSearchCV
+    X, y = _regression_data(400)
+    grid = GridSearchCV(
+        lgb.LGBMRegressor(min_child_samples=10, min_child_weight=1e-3),
+        {"n_estimators": [5, 8], "num_leaves": [7, 15]}, cv=2)
+    grid.fit(X, y)
+    assert grid.best_params_["n_estimators"] == 8
+
+
+def test_pandas_dataframe_with_categoricals():
+    pd = pytest.importorskip("pandas")
+    X, y = _regression_data(600, f=4)
+    df = pd.DataFrame(X, columns=["a", "b", "c", "d"])
+    df["cat"] = pd.Categorical(
+        np.random.RandomState(0).choice(["u", "v", "w"], size=600))
+    y = y + (df["cat"] == "u") * 2.0
+    ds = lgb.Dataset(df, y, params=PARAMS)
+    booster = lgb.train({**PARAMS, "objective": "regression"}, ds,
+                        num_boost_round=20, verbose_eval=False)
+    assert booster.feature_name() == ["a", "b", "c", "d", "cat"]
+    pred = booster.predict(df)
+    assert np.isfinite(pred).all()
