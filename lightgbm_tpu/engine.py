@@ -44,12 +44,12 @@ def train(params, train_set, num_boost_round=100,
         predictor = Booster(model_file=init_model)
     elif isinstance(init_model, Booster):
         predictor = init_model._to_predictor()
-    init_iteration = predictor.current_iteration() if predictor is not None \
-        and hasattr(predictor, "current_iteration") else 0
+    init_iteration = 0
     if predictor is not None:
-        init_iteration = predictor._booster.num_init_iteration or \
-            len(predictor._booster.models) // max(
-                predictor._booster.num_class, 1)
+        # total prior rounds, including any the predictor itself continued
+        # from (chained continued training)
+        init_iteration = len(predictor._booster.models) // max(
+            predictor._booster.num_class, 1)
 
     if not isinstance(train_set, Dataset):
         raise TypeError("Training only accepts Dataset object")
@@ -183,7 +183,6 @@ def _make_n_folds(full_data: Dataset, data_splitter, nfold, params, seed,
             randidx = np.random.RandomState(seed).permutation(num_data)
         else:
             randidx = np.arange(num_data)
-        kstep = int(num_data / nfold)
         test_id = [randidx[i::nfold] for i in range(nfold)]
         folds = [(np.setdiff1d(randidx, test_id[k], assume_unique=False),
                   test_id[k]) for k in range(nfold)]

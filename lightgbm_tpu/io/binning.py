@@ -220,8 +220,19 @@ class BinMapper:
         scalar = values.ndim == 0
         values = np.atleast_1d(values)
         if self.bin_type == NUMERICAL:
-            # First bound >= value.
+            if values.size >= 65536:
+                from .native import values_to_bins_native
+                native = values_to_bins_native(
+                    values, self.bin_upper_bound,
+                    np.uint16 if self.num_bin > 256 else np.uint8)
+                if native is not None:
+                    return (native.astype(np.int64)[0] if scalar
+                            else native.astype(np.int64))
+            # First bound >= value.  NaN lands in bin 0 like the reference's
+            # binary search (bin.h:385-407: `upper_bounds[m] < v` is false
+            # for NaN) — searchsorted alone would put it in the last bin.
             bins = np.searchsorted(self.bin_upper_bound[:-1], values, side="left")
+            bins = np.where(np.isnan(values), 0, bins)
         else:
             bins = np.full(values.shape, self.num_bin - 1, dtype=np.int64)
             ints = values.astype(np.int64)

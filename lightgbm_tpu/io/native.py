@@ -1,0 +1,131 @@
+"""ctypes bindings for the native C++ data-loading runtime (csrc/).
+
+The shared library is built lazily with g++ on first use and cached next to
+the source; every entry point degrades gracefully to the pure-Python path
+when the toolchain or binary is unavailable (import never fails).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_SRC = os.path.join(_CSRC, "data_loader.cpp")
+_LIB_PATH = os.path.join(_CSRC, "build", "liblgbt_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-march=native", _SRC, "-o", _LIB_PATH]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=180)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.warning("native build failed to run: %s", e)
+        return False
+    if res.returncode != 0:
+        log.warning("native build failed:\n%s", res.stderr[-2000:])
+        return False
+    return True
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first call; None if
+    unavailable (callers fall back to Python)."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            log.warning("could not load native library: %s", e)
+            return None
+        lib.lgbt_parse_file.restype = ctypes.c_int
+        lib.lgbt_parse_file.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int)]
+        lib.lgbt_free.restype = None
+        lib.lgbt_free.argtypes = [ctypes.c_void_p]
+        lib.lgbt_values_to_bins.restype = None
+        lib.lgbt_values_to_bins.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint16),
+            ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+_FMT_NAMES = {0: "csv", 1: "tsv", 2: "libsvm"}
+
+
+def parse_file_native(path: str, has_header: bool = False,
+                      label_idx: int = 0
+                      ) -> Optional[Tuple[np.ndarray, np.ndarray, str]]:
+    """Parse with the C++ loader; returns (label, X, fmt) or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    data_p = ctypes.POINTER(ctypes.c_double)()
+    label_p = ctypes.POINTER(ctypes.c_double)()
+    nrows = ctypes.c_int64()
+    ncols = ctypes.c_int64()
+    fmt = ctypes.c_int()
+    rc = lib.lgbt_parse_file(path.encode(), int(has_header), int(label_idx),
+                             ctypes.byref(data_p), ctypes.byref(label_p),
+                             ctypes.byref(nrows), ctypes.byref(ncols),
+                             ctypes.byref(fmt))
+    if rc != 0:
+        return None
+    n, f = nrows.value, ncols.value
+    try:
+        X = np.ctypeslib.as_array(data_p, shape=(n, f)).copy()
+        y = np.ctypeslib.as_array(label_p, shape=(n,)).copy()
+    finally:
+        lib.lgbt_free(data_p)
+        lib.lgbt_free(label_p)
+    return y, X, _FMT_NAMES.get(fmt.value, "csv")
+
+
+def values_to_bins_native(values: np.ndarray, upper_bounds: np.ndarray,
+                          out_dtype=np.uint8) -> Optional[np.ndarray]:
+    """Numerical ValueToBin via the native binary search; None if no lib."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    values = np.ascontiguousarray(values, np.float64)
+    bounds = np.ascontiguousarray(upper_bounds, np.float64)
+    n = values.size
+    is16 = np.dtype(out_dtype) == np.uint16
+    out = np.empty(n, dtype=np.uint16 if is16 else np.uint8)
+    lib.lgbt_values_to_bins(
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n,
+        bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        len(bounds),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)), int(is16))
+    return out

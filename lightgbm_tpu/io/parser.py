@@ -86,7 +86,31 @@ def _parse_libsvm(lines: List[str], num_features: Optional[int] = None
 def parse_file(path: str, has_header: bool = False, label_idx: int = 0,
                num_features: Optional[int] = None
                ) -> Tuple[np.ndarray, np.ndarray, Optional[List[str]]]:
-    """Parse a data file.  Returns (label, features[N,F], header_names)."""
+    """Parse a data file.  Returns (label, features[N,F], header_names).
+
+    Uses the native multithreaded C++ loader (csrc/data_loader.cpp) when it
+    is available; the NumPy path below is the fallback and the behavioral
+    reference for tests."""
+    from .native import parse_file_native
+    native = parse_file_native(path, has_header=has_header,
+                               label_idx=label_idx)
+    if native is not None:
+        label, feats, fmt = native
+        header: Optional[List[str]] = None
+        if has_header:
+            with open(path, "r") as fh:
+                first = fh.readline().rstrip("\r\n")
+            delim = {"csv": ",", "tsv": "\t"}.get(fmt, "\t")
+            header = first.split(delim)
+            if label_idx >= 0 and fmt != "libsvm" and len(header) > label_idx:
+                header = header[:label_idx] + header[label_idx + 1:]
+        if num_features is not None and feats.shape[1] != num_features:
+            fixed = np.zeros((feats.shape[0], num_features), np.float64)
+            upto = min(num_features, feats.shape[1])
+            fixed[:, :upto] = feats[:, :upto]
+            feats = fixed
+        return label, feats, header
+
     with open(path, "r") as fh:
         lines = fh.read().splitlines()
     header: Optional[List[str]] = None
@@ -95,6 +119,8 @@ def parse_file(path: str, has_header: bool = False, label_idx: int = 0,
     if has_header and lines:
         delim = {"csv": ",", "tsv": "\t"}.get(fmt, "\t")
         header = lines[0].split(delim)
+        if label_idx >= 0 and fmt != "libsvm" and len(header) > label_idx:
+            header = header[:label_idx] + header[label_idx + 1:]
         lines = lines[1:]
     if fmt == "libsvm":
         label, feats = _parse_libsvm(lines, num_features)

@@ -315,7 +315,8 @@ class LGBMClassifier(LGBMModel, _SKClassifier):
         self.classes_ = self._le.classes_
         self.n_classes_ = len(self.classes_)
         if self.n_classes_ > 2:
-            self.objective = "multiclass"
+            if not callable(self.objective):
+                self.objective = "multiclass"
             self._other_params["num_class"] = self.n_classes_
         eval_set = kwargs.get("eval_set")
         if eval_set is not None:
