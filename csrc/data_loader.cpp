@@ -113,6 +113,17 @@ inline long parse_long(const char* p, const char* end, const char** out) {
   return neg ? -v : v;
 }
 
+// Does the first whitespace-delimited token contain ':'?  LibSVM rows whose
+// first token is an index:value pair have no label (parser.py:67-71).
+inline bool first_token_has_colon(const char* p, const char* e) {
+  p = skip_space(p, e);
+  while (p < e && *p != ' ' && *p != '\t') {
+    if (*p == ':') return true;
+    ++p;
+  }
+  return false;
+}
+
 struct LineIndex {
   std::vector<const char*> begin;
   std::vector<const char*> end;
@@ -192,7 +203,8 @@ int lgbt_parse_file(const char* path, int has_header, int label_idx,
   fseek(fh, 0, SEEK_END);
   long fsize = ftell(fh);
   fseek(fh, 0, SEEK_SET);
-  std::vector<char> buf(static_cast<size_t>(fsize));
+  // +1 terminator: strtod in parse_double must not scan past the buffer
+  std::vector<char> buf(static_cast<size_t>(fsize) + 1, '\0');
   if (fsize > 0 && fread(buf.data(), 1, static_cast<size_t>(fsize), fh) !=
                        static_cast<size_t>(fsize)) {
     fclose(fh);

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Regenerate docs/Parameters.md from the live config system."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+from lightgbm_tpu.config import (_BOOL_KEYS, _DEFAULTS, _FLOAT_KEYS,
+                                 _INT_KEYS, _LIST_KEYS, PARAM_ALIASES)
+
+DESC_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "_param_descriptions.py")
+DESC = {}
+if os.path.exists(DESC_PATH):
+    ns = {}
+    exec(open(DESC_PATH).read(), ns)
+    DESC = ns.get("DESC", {})
+
+
+def main():
+    lines = ["# Parameters", "",
+             "All parameters of lightgbm_tpu, with defaults and aliases. "
+             "The same",
+             "key=value surface is accepted by the CLI (conf files + argv), "
+             "the C-ABI-free",
+             "Python `params` dicts, and the sklearn wrappers. Alias "
+             "resolution matches the",
+             "reference's ParameterAlias::KeyAliasTransform "
+             "(config.h:322-416): canonical",
+             "keys win over aliases.", "",
+             "| Parameter | Default | Type | Aliases | Description |",
+             "|---|---|---|---|---|"]
+    rev = {}
+    for alias, canon in PARAM_ALIASES.items():
+        if alias != canon:
+            rev.setdefault(canon, []).append(alias)
+    for key in sorted(_DEFAULTS):
+        d = _DEFAULTS[key]
+        t = ("list" if key in _LIST_KEYS else "bool" if key in _BOOL_KEYS
+             else "int" if key in _INT_KEYS
+             else "float" if key in _FLOAT_KEYS else "str")
+        aliases = ", ".join(sorted(rev.get(key, []))) or "—"
+        dv = repr(d) if d != "" else "''"
+        lines.append(f"| `{key}` | {dv} | {t} | {aliases} | "
+                     f"{DESC.get(key, '')} |")
+    lines += ["", "Generated from `lightgbm_tpu/config.py` "
+                  "(`_DEFAULTS` + `PARAM_ALIASES`).",
+              "Regenerate with `python docs/gen_parameters.py`.", ""]
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "Parameters.md")
+    with open(out, "w") as fh:
+        fh.write("\n".join(lines))
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Generate the synthetic datasets used by the example conf files.
+
+Run once from the examples/ directory:  python gen_data.py
+
+Produces, per task directory, <name>.train / <name>.test files in the same
+TSV (label first) or LibSVM layouts the reference's bundled examples use
+(the reference ships real data files; we synthesize equivalents instead of
+copying them)."""
+
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _write_tsv(path, y, X):
+    with open(path, "w") as fh:
+        for yi, row in zip(y, X):
+            fh.write(f"{yi:g}\t" + "\t".join(f"{v:.6g}" for v in row) + "\n")
+
+
+def regression(n=7000, f=28, seed=1):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] * 2 + np.sin(3 * X[:, 1]) + X[:, 2] * X[:, 3]
+         + 0.3 * rng.normal(size=n))
+    d = os.path.join(HERE, "regression")
+    _write_tsv(os.path.join(d, "regression.train"), y[:5000], X[:5000])
+    _write_tsv(os.path.join(d, "regression.test"), y[5000:], X[5000:])
+
+
+def binary(n=7000, f=28, seed=2):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    logit = X[:, 0] + 0.8 * X[:, 1] * X[:, 2] - 0.6 * X[:, 3]
+    y = (logit + 0.5 * rng.normal(size=n) > 0).astype(int)
+    d = os.path.join(HERE, "binary_classification")
+    _write_tsv(os.path.join(d, "binary.train"), y[:5000], X[:5000])
+    _write_tsv(os.path.join(d, "binary.test"), y[5000:], X[5000:])
+    # weight side file (reference binary.train.weight)
+    w = rng.uniform(0.5, 1.5, size=5000)
+    with open(os.path.join(d, "binary.train.weight"), "w") as fh:
+        fh.writelines(f"{v:.4g}\n" for v in w)
+
+
+def multiclass(n=6000, f=20, k=5, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    centers = rng.normal(scale=2.0, size=(k, f))
+    logits = X @ centers.T + rng.normal(scale=2.0, size=(n, k))
+    y = np.argmax(logits, axis=1)
+    d = os.path.join(HERE, "multiclass_classification")
+    _write_tsv(os.path.join(d, "multiclass.train"), y[:4500], X[:4500])
+    _write_tsv(os.path.join(d, "multiclass.test"), y[4500:], X[4500:])
+
+
+def lambdarank(n_query=200, f=30, seed=4):
+    rng = np.random.RandomState(seed)
+    d = os.path.join(HERE, "lambdarank")
+    for split, nq in (("train", n_query), ("test", n_query // 4)):
+        rows = []
+        qsizes = []
+        for _ in range(nq):
+            sz = rng.randint(5, 25)
+            qsizes.append(sz)
+            Xq = rng.normal(size=(sz, f))
+            rel = np.clip((Xq[:, 0] + 0.5 * Xq[:, 1]
+                           + 0.5 * rng.normal(size=sz)) * 1.2, 0, 4)
+            for r, x in zip(rel.astype(int), Xq):
+                feats = " ".join(f"{j}:{v:.5g}" for j, v in enumerate(x)
+                                 if abs(v) > 0.05)
+                rows.append(f"{r} {feats}")
+        with open(os.path.join(d, f"rank.{split}"), "w") as fh:
+            fh.write("\n".join(rows) + "\n")
+        with open(os.path.join(d, f"rank.{split}.query"), "w") as fh:
+            fh.writelines(f"{q}\n" for q in qsizes)
+
+
+if __name__ == "__main__":
+    regression()
+    binary()
+    multiclass()
+    lambdarank()
+    print("example datasets written")

@@ -19,8 +19,14 @@ from .sklearn import (LGBMClassifier, LGBMModel,  # noqa: F401
                       LGBMRanker, LGBMRegressor)
 from .utils.log import LightGBMError  # noqa: F401
 
+try:
+    from .plotting import plot_importance, plot_metric, plot_tree  # noqa: F401
+    _PLOTTING = ["plot_importance", "plot_metric", "plot_tree"]
+except ImportError:  # matplotlib not installed
+    _PLOTTING = []
+
 __all__ = ["Dataset", "Booster", "Config",
            "train", "cv", "CVBooster",
            "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
            "print_evaluation", "record_evaluation", "reset_parameter",
-           "early_stopping", "LightGBMError"]
+           "early_stopping", "LightGBMError"] + _PLOTTING

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Convert a LightGBM-TPU model text file to PMML.
+
+Role-parity with the reference's pmml/pmml.py tool: reads the saved model
+(the reference-compatible text format written by Booster.save_model) and
+emits a PMML 4.3 MiningModel of segmented TreeModels (sum aggregation).
+
+Usage: python pmml/pmml.py <model_file> [output_file]
+"""
+
+from __future__ import annotations
+
+import sys
+from itertools import count
+from xml.sax.saxutils import quoteattr
+
+
+def _parse_model(text):
+    """Parse the model text into header fields + per-tree dicts."""
+    header = {}
+    trees = []
+    cur = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("Tree="):
+            cur = {}
+            trees.append(cur)
+            continue
+        if line.startswith("feature importances"):
+            cur = None
+            continue
+        if "=" in line:
+            key, value = line.split("=", 1)
+            if cur is None:
+                header[key] = value
+            else:
+                cur[key] = value
+    return header, trees
+
+
+def _arr(tree, key, conv=float):
+    return [conv(t) for t in tree.get(key, "").split()] if tree.get(key) \
+        else []
+
+
+def _tree_to_pmml(tree, feature_names, out, tree_idx):
+    num_leaves = int(tree["num_leaves"])
+    split_feature = _arr(tree, "split_feature", int)
+    threshold = _arr(tree, "threshold", float)
+    decision_type = _arr(tree, "decision_type", int)
+    left_child = _arr(tree, "left_child", int)
+    right_child = _arr(tree, "right_child", int)
+    leaf_value = _arr(tree, "leaf_value", float)
+    leaf_count = _arr(tree, "leaf_count", int) or [0] * num_leaves
+    internal_value = _arr(tree, "internal_value", float) or [0.0] * max(
+        num_leaves - 1, 0)
+    internal_count = _arr(tree, "internal_count", int) or [0] * max(
+        num_leaves - 1, 0)
+    leaf_parent = _arr(tree, "leaf_parent", int) or [-1] * num_leaves
+    uid = count(1)
+
+    out.append(f'\t\t<Segment id="{tree_idx + 1}">')
+    out.append('\t\t\t<True />')
+    out.append('\t\t\t<TreeModel functionName="regression" '
+               'splitCharacteristic="binarySplit">')
+    out.append('\t\t\t\t<MiningSchema>')
+    for name in feature_names:
+        out.append(f'\t\t\t\t\t<MiningField name={quoteattr(name)} />')
+    out.append('\t\t\t\t</MiningSchema>')
+
+    def predicate(tabs, node_id, is_left, parent_idx, is_leaf):
+        idx = leaf_parent[node_id] if is_leaf else parent_idx
+        if idx < 0:
+            out.append("\t" * (tabs + 1) + "<True />")
+            return
+        field = feature_names[split_feature[idx]]
+        if is_left:
+            op = "equal" if decision_type[idx] == 1 else "lessOrEqual"
+        else:
+            op = "notEqual" if decision_type[idx] == 1 else "greaterThan"
+        out.append("\t" * (tabs + 1)
+                   + f'<SimplePredicate field={quoteattr(field)} '
+                   f'operator="{op}" value="{threshold[idx]:g}" />')
+
+    def emit(node_id, tabs, is_left, parent_idx):
+        if node_id < 0:
+            leaf = ~node_id
+            score, record = leaf_value[leaf], leaf_count[leaf]
+            is_leaf = True
+            nid = leaf
+        else:
+            score, record = internal_value[node_id], internal_count[node_id]
+            is_leaf = False
+            nid = node_id
+        out.append("\t" * tabs + f'<Node id="{next(uid)}" score="{score:g}" '
+                                 f'recordCount="{record}">')
+        predicate(tabs, nid, is_left, parent_idx, is_leaf)
+        if not is_leaf:
+            emit(left_child[node_id], tabs + 1, True, node_id)
+            emit(right_child[node_id], tabs + 1, False, node_id)
+        out.append("\t" * tabs + "</Node>")
+
+    if num_leaves > 1:
+        emit(0, 4, True, -1)
+    else:
+        out.append(f'\t\t\t\t<Node id="1" score='
+                   f'"{leaf_value[0] if leaf_value else 0.0:g}" '
+                   'recordCount="0"><True /></Node>')
+    out.append('\t\t\t</TreeModel>')
+    out.append('\t\t</Segment>')
+
+
+def model_to_pmml(text: str) -> str:
+    header, trees = _parse_model(text)
+    feature_names = header.get("feature_names", "").split()
+    out = ['<?xml version="1.0" encoding="UTF-8"?>',
+           '<PMML version="4.3" xmlns="http://www.dmg.org/PMML-4_3">',
+           '\t<Header copyright="lightgbm_tpu" />',
+           '\t<DataDictionary>']
+    for name in feature_names:
+        out.append(f'\t\t<DataField name={quoteattr(name)} '
+                   'optype="continuous" dataType="double" />')
+    out.append('\t</DataDictionary>')
+    out.append('\t<MiningModel functionName="regression">')
+    out.append('\t\t<MiningSchema>')
+    for name in feature_names:
+        out.append(f'\t\t\t<MiningField name={quoteattr(name)} />')
+    out.append('\t\t</MiningSchema>')
+    out.append('\t<Segmentation multipleModelMethod="sum">')
+    for i, tree in enumerate(trees):
+        _tree_to_pmml(tree, feature_names, out, i)
+    out.append('\t</Segmentation>')
+    out.append('\t</MiningModel>')
+    out.append('</PMML>')
+    return "\n".join(out) + "\n"
+
+
+def main(argv):
+    if len(argv) < 2:
+        print(__doc__)
+        return 1
+    with open(argv[1]) as fh:
+        pmml = model_to_pmml(fh.read())
+    out_path = argv[2] if len(argv) > 2 else argv[1] + ".pmml"
+    with open(out_path, "w") as fh:
+        fh.write(pmml)
+    print(f"wrote {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,68 @@
+"""plot_importance / plot_metric / plot_tree (reference
+test_plotting.py shapes) and the PMML converter."""
+
+import os
+import sys
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+matplotlib = pytest.importorskip("matplotlib")
+matplotlib.use("Agg")
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(500, 6))
+    y = X[:, 0] * 2 + X[:, 1] + 0.1 * rng.normal(size=500)
+    params = {"objective": "regression", "num_leaves": 7,
+              "min_data_in_leaf": 10, "verbose": 0}
+    ds = lgb.Dataset(X[:400], y[:400], params=params)
+    vs = ds.create_valid(X[400:], y[400:])
+    res = {}
+    booster = lgb.train(params, ds, num_boost_round=10, valid_sets=[vs],
+                        evals_result=res, verbose_eval=False)
+    return booster, res
+
+
+def test_plot_importance(fitted):
+    booster, _ = fitted
+    ax = lgb.plot_importance(booster)
+    assert ax.get_title() == "Feature importance"
+    assert len(ax.patches) >= 1
+
+
+def test_plot_metric(fitted):
+    _, res = fitted
+    ax = lgb.plot_metric(res)
+    assert ax.get_ylabel() == "l2"
+    assert len(ax.lines) == 1
+
+
+def test_plot_tree(fitted):
+    booster, _ = fitted
+    ax = lgb.plot_tree(booster, tree_index=0)
+    assert len(ax.texts) >= booster.dump_model()["tree_info"][0]["num_leaves"]
+
+
+def test_pmml_converter(fitted, tmp_path):
+    booster, _ = fitted
+    model_path = str(tmp_path / "model.txt")
+    booster.save_model(model_path)
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "pmml"))
+    try:
+        import pmml as pmml_mod
+    finally:
+        sys.path.pop(0)
+    out = pmml_mod.model_to_pmml(open(model_path).read())
+    root = ET.fromstring(out)
+    ns = "{http://www.dmg.org/PMML-4_3}"
+    segments = root.findall(f".//{ns}Segment")
+    assert len(segments) == 10
+    nodes = root.findall(f".//{ns}Node")
+    assert len(nodes) > 10 * 7  # >= leaves+internals per tree
