@@ -212,7 +212,10 @@ int lgbt_parse_file(const char* path, int has_header, int label_idx,
   }
   fclose(fh);
 
-  LineIndex idx = index_lines(buf.data(), buf.size());
+  // Index only the true file bytes — buf has a +1 NUL terminator for the
+  // float parser, and including it would turn the terminator into a phantom
+  // 1-char final line (a bogus all-zero row on every newline-terminated file).
+  LineIndex idx = index_lines(buf.data(), static_cast<size_t>(fsize));
   size_t first_row = has_header ? 1 : 0;
   if (idx.begin.size() <= first_row) {
     *num_rows_out = 0;
@@ -234,10 +237,13 @@ int lgbt_parse_file(const char* path, int has_header, int label_idx,
       for (int64_t r = lo; r < hi; ++r) {
         const char* p = idx.begin[first_row + r];
         const char* e = idx.end[first_row + r];
-        // skip label
         const char* q;
-        parse_double(p, e, &q);
-        p = q;
+        // A first token containing ':' is an index:value pair — the row has
+        // no label (standard predict-time LibSVM; parser.py:67-71).
+        if (!first_token_has_colon(p, e)) {
+          parse_double(p, e, &q);  // skip label
+          p = q;
+        }
         while (p < e) {
           p = skip_space(p, e);
           if (p >= e) break;
@@ -287,8 +293,12 @@ int lgbt_parse_file(const char* path, int has_header, int label_idx,
         double* row = data + r * ncols;
         memset(row, 0, sizeof(double) * ncols);
         const char* q;
-        label[r] = parse_double(p, e, &q);
-        p = q;
+        if (first_token_has_colon(p, e)) {
+          label[r] = 0.0;  // label-less row (predict-time LibSVM)
+        } else {
+          label[r] = parse_double(p, e, &q);
+          p = q;
+        }
         while (p < e) {
           p = skip_space(p, e);
           if (p >= e) break;

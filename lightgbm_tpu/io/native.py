@@ -1,13 +1,16 @@
 """ctypes bindings for the native C++ data-loading runtime (csrc/).
 
-The shared library is built lazily with g++ on first use and cached next to
-the source; every entry point degrades gracefully to the pure-Python path
-when the toolchain or binary is unavailable (import never fails).
+The shared library is built lazily with g++ on first use and cached in a
+per-user cache directory keyed by a hash of the source, so read-only installs
+keep the fast path and binaries are never shared across incompatible hosts;
+every entry point degrades gracefully to the pure-Python path when the
+toolchain or binary is unavailable (import never fails).
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -20,27 +23,81 @@ from ..utils import log
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "csrc")
 _SRC = os.path.join(_CSRC, "data_loader.cpp")
-_LIB_PATH = os.path.join(_CSRC, "build", "liblgbt_native.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
-    os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           "-march=native", _SRC, "-o", _LIB_PATH]
+# -O3 only: -march=native binaries SIGILL when the cache dir is shared
+# across heterogeneous hosts, and the hot loops here are memory-bound.
+_BUILD_FLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC", "-pthread"]
+
+
+def _compiler_tag() -> str:
+    """Compiler + platform identity, part of the cache key so hosts with
+    incompatible toolchains/runtimes sharing a cache dir never thrash each
+    other's binaries."""
+    import platform
     try:
-        res = subprocess.run(cmd, capture_output=True, text=True,
-                             timeout=180)
-    except (OSError, subprocess.TimeoutExpired) as e:
-        log.warning("native build failed to run: %s", e)
-        return False
-    if res.returncode != 0:
-        log.warning("native build failed:\n%s", res.stderr[-2000:])
-        return False
-    return True
+        ver = subprocess.run(["g++", "-dumpfullversion", "-dumpversion"],
+                             capture_output=True, text=True,
+                             timeout=30).stdout.strip()
+    except (OSError, subprocess.TimeoutExpired):
+        ver = "unknown"
+    return f"{ver}-{platform.machine()}-{platform.libc_ver()[1]}"
+
+
+def _lib_path() -> str:
+    """Cache location: $LGBT_NATIVE_CACHE or XDG cache dir, keyed by a hash
+    of (source text, build flags, compiler/platform identity) so source or
+    flag edits force a rebuild and heterogeneous hosts sharing a filesystem
+    never load each other's binaries."""
+    cache_root = os.environ.get("LGBT_NATIVE_CACHE") or os.path.join(
+        os.environ.get("XDG_CACHE_HOME")
+        or os.path.join(os.path.expanduser("~"), ".cache"),
+        "lightgbm_tpu")
+    key = hashlib.sha256()
+    try:
+        with open(_SRC, "rb") as fh:
+            key.update(fh.read())
+    except OSError:
+        key.update(b"nosrc")
+    key.update(" ".join(_BUILD_FLAGS).encode())
+    key.update(_compiler_tag().encode())
+    return os.path.join(cache_root,
+                        f"liblgbt_native-{key.hexdigest()[:16]}.so")
+
+
+def _build(lib_path: str) -> bool:
+    os.makedirs(os.path.dirname(lib_path), exist_ok=True)
+    # Build to a temp name and rename into place: the cache dir may be
+    # shared, and a killed/concurrent build must never leave a truncated
+    # .so at the final path (os.rename is atomic within a filesystem).
+    tmp_path = f"{lib_path}.tmp.{os.getpid()}"
+    cmd = ["g++", *_BUILD_FLAGS, _SRC, "-o", tmp_path]
+    try:
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=180)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            log.warning("native build failed to run: %s", e)
+            return False
+        if res.returncode != 0:
+            log.warning("native build failed:\n%s", res.stderr[-2000:])
+            return False
+        try:
+            os.rename(tmp_path, lib_path)
+        except OSError as e:
+            log.warning("could not move native library into cache: %s", e)
+            return False
+        return True
+    finally:
+        if os.path.exists(tmp_path):
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
@@ -51,16 +108,26 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH) or (
-                os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)):
-            if not _build():
+        lib_path = _lib_path()
+        if not os.path.exists(lib_path):
+            if not _build(lib_path):
                 return None
         try:
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError as e:
-            log.warning("could not load native library: %s", e)
-            return None
+            lib = ctypes.CDLL(lib_path)
+        except OSError:
+            # A stale/corrupt cached binary (e.g. from an older scheme or a
+            # foreign host): rebuild once before giving up.
+            try:
+                os.unlink(lib_path)
+            except OSError:
+                pass
+            if not _build(lib_path):
+                return None
+            try:
+                lib = ctypes.CDLL(lib_path)
+            except OSError as e:
+                log.warning("could not load native library: %s", e)
+                return None
         lib.lgbt_parse_file.restype = ctypes.c_int
         lib.lgbt_parse_file.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int,

@@ -24,9 +24,16 @@ def _python_parse(path, **kw):
 
 @needs_native
 @pytest.mark.parametrize("path", [
-    f"{REF}/regression/regression.train",       # tsv
+    f"{REF}/regression/regression.train",        # tsv
+    f"{REF}/regression/regression.test",
+    f"{REF}/binary_classification/binary.train",
     f"{REF}/binary_classification/binary.test",  # tsv
+    f"{REF}/multiclass_classification/multiclass.train",
+    f"{REF}/multiclass_classification/multiclass.test",
     f"{REF}/lambdarank/rank.train",              # libsvm
+    f"{REF}/lambdarank/rank.test",
+    f"{REF}/parallel_learning/binary.train",
+    f"{REF}/parallel_learning/binary.test",
 ])
 def test_native_matches_python_on_reference_files(path):
     y_n, X_n, _ = parse_file_native(path)
@@ -34,6 +41,32 @@ def test_native_matches_python_on_reference_files(path):
     assert X_n.shape == X_p.shape
     np.testing.assert_allclose(y_n, y_p, rtol=1e-12)
     np.testing.assert_allclose(X_n, X_p, rtol=1e-9, atol=1e-12)
+
+
+@needs_native
+def test_native_no_trailing_newline(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("1,0.5,2\n0,1.5,3")  # last line unterminated
+    y, X, _ = parse_file_native(str(p))
+    np.testing.assert_allclose(y, [1, 0])
+    np.testing.assert_allclose(X, [[0.5, 2.0], [1.5, 3.0]])
+
+
+@needs_native
+def test_native_libsvm_label_less_rows(tmp_path):
+    # Predict-time LibSVM: first token is an index:value pair, so the row
+    # has no label (parser.py:67-71); label must default to 0 and feature 0
+    # must NOT swallow the first pair.
+    p = tmp_path / "d.svm"
+    p.write_text("0:1.5 2:2.5\n1:3.5\n")
+    y_n, X_n, fmt = parse_file_native(str(p))
+    assert fmt == "libsvm"
+    y_p, X_p, _ = _python_parse(str(p))
+    assert X_n.shape == X_p.shape == (2, 3)
+    np.testing.assert_allclose(y_n, [0.0, 0.0])
+    np.testing.assert_allclose(X_n, [[1.5, 0.0, 2.5], [0.0, 3.5, 0.0]])
+    np.testing.assert_allclose(X_n, X_p)
+    np.testing.assert_allclose(y_n, y_p)
 
 
 @needs_native
