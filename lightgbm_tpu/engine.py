@@ -121,7 +121,7 @@ def train(params, train_set, num_boost_round=100,
                                     end_iteration=init_iteration
                                     + num_boost_round,
                                     evaluation_result_list=None))
-        booster.update(fobj=fobj)
+        finished = booster.update(fobj=fobj)
 
         evaluation_result_list = []
         if is_valid_contain_train:
@@ -137,6 +137,11 @@ def train(params, train_set, num_boost_round=100,
                     evaluation_result_list=evaluation_result_list))
         except callback.EarlyStopException as e:
             booster.best_iteration = e.best_iteration + 1
+            break
+        if finished:
+            # No leaf met the split requirements: the model is saturated and
+            # further rounds would re-do full histogram work for nothing
+            # (the CLI loop breaks the same way, application.cpp:231).
             break
     return booster
 

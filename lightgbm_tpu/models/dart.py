@@ -35,33 +35,36 @@ class DART(GBDT):
         self.drop_index: List[int] = []
         self.shrinkage_rate = config.learning_rate
 
-    # -- drop bookkeeping (dart.hpp:84-137) ------------------------------
+    # -- drop bookkeeping (dart.hpp:84-128) ------------------------------
     def _select_dropping_trees(self) -> None:
+        """DroppingTrees (dart.hpp:84-128): per-tree Bernoulli draws;
+        max_drop caps the drop *rate* (not the count); xgboost mode uses
+        shrinkage lr/(lr+k) instead of lr/(1+k)."""
         self.drop_index = []
+        lr = self.config.learning_rate
         num_iters = self.iter_
-        if num_iters <= 0:
-            self.shrinkage_rate = self.config.learning_rate
-            return
-        if self._drop_rng.uniform() < self.skip_drop:
-            # skip dropout this round
-            self.shrinkage_rate = self.config.learning_rate
-            return
-        rate = self.drop_rate
-        if self.uniform_drop:
-            for i in range(num_iters):
-                if self._drop_rng.uniform() < rate:
-                    self.drop_index.append(i)
-        else:
-            inv_avg = num_iters / max(self.sum_weight, 1e-12)
-            for i in range(num_iters):
-                if self._drop_rng.uniform() < rate * self.tree_weights[i] * inv_avg:
-                    self.drop_index.append(i)
-        if len(self.drop_index) > self.max_drop:
-            keep = self._drop_rng.choice(len(self.drop_index), self.max_drop,
-                                         replace=False)
-            self.drop_index = [self.drop_index[i] for i in sorted(keep)]
+        if num_iters > 0 and not (self._drop_rng.uniform() < self.skip_drop):
+            rate = self.drop_rate
+            if not self.uniform_drop:
+                inv_avg = num_iters / max(self.sum_weight, 1e-12)
+                if self.max_drop > 0:
+                    rate = min(rate, self.max_drop * inv_avg
+                               / max(self.sum_weight, 1e-12))
+                for i in range(num_iters):
+                    if (self._drop_rng.uniform()
+                            < rate * self.tree_weights[i] * inv_avg):
+                        self.drop_index.append(i)
+            else:
+                if self.max_drop > 0:
+                    rate = min(rate, self.max_drop / float(num_iters))
+                for i in range(num_iters):
+                    if self._drop_rng.uniform() < rate:
+                        self.drop_index.append(i)
         k = len(self.drop_index)
-        self.shrinkage_rate = self.config.learning_rate / (1.0 + k)
+        if not self.xgboost_dart_mode:
+            self.shrinkage_rate = lr / (1.0 + k)
+        else:
+            self.shrinkage_rate = lr if k == 0 else lr / (lr + k)
 
     def _apply_drop(self) -> None:
         """Subtract dropped trees from all scores."""
@@ -74,16 +77,20 @@ class DART(GBDT):
                     self._add_host_tree_to(dd, neg, cls)
 
     def _normalize(self) -> None:
-        """dart.hpp:139-178: re-add dropped trees scaled by k/(k+1)."""
-        k = len(self.drop_index)
-        new_tree_idx = self.iter_ - 1  # tree just trained
-        if self.xgboost_dart_mode:
-            scale_new = self.shrinkage_rate  # lr/(1+k) already applied at train
+        """Normalize (dart.hpp:139-178): re-add dropped trees scaled by
+        k/(k+1), or k/(k+lr) in xgboost mode; weight bookkeeping mirrors
+        the reference (including its 1/(k+lr) subtraction quirk)."""
+        k = float(len(self.drop_index))
+        lr = self.config.learning_rate
+        if not self.xgboost_dart_mode:
             factor_dropped = k / (k + 1.0)
+            weight_sub = 1.0 / (k + 1.0)
         else:
-            factor_dropped = k / (k + 1.0)
-        # new tree already added with shrinkage lr/(1+k): matches reference,
-        # which shrinks by shrinkage_rate_ then Normalize.
+            factor_dropped = k / (k + lr)
+            weight_sub = 1.0 / (k + lr)
+        # The new tree is already added with shrinkage lr/(1+k) (or
+        # lr/(lr+k)): matches the reference, which shrinks at train time and
+        # then normalizes only the dropped trees.
         for it in self.drop_index:
             for cls in range(self.num_class):
                 idx = it * self.num_class + cls
@@ -94,10 +101,9 @@ class DART(GBDT):
                 self._add_host_tree_to(self.train_data, scaled, cls)
                 for dd in self.valid_data:
                     self._add_host_tree_to(dd, scaled, cls)
+            if not self.uniform_drop:
+                self.sum_weight -= self.tree_weights[it] * weight_sub
                 self.tree_weights[it] *= factor_dropped
-        # weight bookkeeping for the new tree
-        if k > 0:
-            self.sum_weight = sum(self.tree_weights)
 
     def train_one_iter(self, grad=None, hess=None) -> bool:
         self._select_dropping_trees()

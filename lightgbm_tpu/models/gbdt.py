@@ -30,9 +30,16 @@ class _DeviceData:
     """Device-resident binned dataset + per-dataset score buffer
     (ScoreUpdater, score_updater.hpp:23-99)."""
 
-    def __init__(self, dataset: BinnedDataset, num_models: int):
+    def __init__(self, dataset: BinnedDataset, num_models: int,
+                 with_row_major: bool = False):
         self.dataset = dataset
-        self.bins = jnp.asarray(dataset.bins.astype(np.int32))
+        # Native uint8/uint16 on device (int32 would 4x the HBM footprint
+        # and the histogram kernel's read traffic).
+        self.bins = jnp.asarray(dataset.bins)
+        # Row-major copy for the cached serial learner's leaf gathers
+        # (ops/leafhist.py needs rows contiguous).
+        self.bins_rm = (jnp.asarray(np.ascontiguousarray(dataset.bins.T))
+                        if with_row_major else None)
         self.num_data = dataset.num_data
         init = np.zeros((num_models, self.num_data), np.float32)
         if dataset.metadata.init_score is not None:
@@ -87,7 +94,8 @@ class GBDT:
         self.grow_params = self._make_grow_params(cfg)
         self.shrinkage_rate = cfg.learning_rate
 
-        self.train_data = _DeviceData(train_set, self.num_class)
+        self.train_data = _DeviceData(train_set, self.num_class,
+                                      with_row_major=True)
         self.valid_data: List[_DeviceData] = []
         self.valid_metrics: List[List[Metric]] = []
         self.train_metrics = self._make_metrics(cfg, train_set)
@@ -140,7 +148,8 @@ class GBDT:
                         "available; falling back to serial",
                         cfg.tree_learner, ndev)
         params = self.grow_params
-        return lambda *args: grow_tree(*args, params)
+        bins_rm = self.train_data.bins_rm
+        return lambda *args: grow_tree(*args, params, bins_rm=bins_rm)
 
     def reset_config(self, config: Config) -> None:
         """Booster::ResetConfig (c_api.cpp:96-134): re-derive learner

@@ -33,6 +33,24 @@ from .split import (BestSplit, SplitParams, find_best_split, leaf_output,
                     K_MIN_SCORE)
 
 
+class _SerialPrep(NamedTuple):
+    """Per-tree device state for the cached serial learner."""
+    bins_rm: jax.Array     # [N, F] row-major bins
+    digits: jax.Array      # [N, 9] int8 fixed-point g/h/w digits
+    scales: jax.Array      # [3] f32 quantization scales
+
+
+class _StepInfo(NamedTuple):
+    """Everything the partition step already knows about the split being
+    applied, handed to the comm so it never re-derives masks."""
+    leaf_id: jax.Array     # [N] AFTER the partition update
+    in_leaf: jax.Array     # [N] bool, rows of the split leaf (pre-update)
+    go_right: jax.Array    # [N] bool, rows moving to the right child
+    parent_leaf: jax.Array  # scalar i32 (left child keeps this slot)
+    right_leaf: jax.Array   # scalar i32
+    do_split: jax.Array     # scalar bool
+
+
 class SerialComm(NamedTuple):
     """Single-device communication strategy: no collectives.
 
@@ -42,28 +60,99 @@ class SerialComm(NamedTuple):
     Allreduce, feature_parallel Allreduce-max, voting Allgather+elect) into
     the same growth loop without duplicating it.  Interface:
 
-      reduce_sums((g, h, c))            -> globally-reduced leaf totals
-      root_split(...)   -> BestSplit [] for the root leaf
-      children_splits(...) -> BestSplit [2] for a fresh left/right pair
+      reduce_sums((g, h, c))          -> globally-reduced leaf totals
+      prepare(...)                    -> opaque per-tree state (closure data)
+      root_split(...)                 -> (BestSplit, histogram cache pytree)
+      children_splits(...)            -> (BestSplit [2], updated cache)
+
+    With ``leaf_cache=True`` (the default) the serial learner reproduces the
+    reference's core cost structure (serial_tree_learner.cpp:398-453): keep
+    every live leaf's histogram cached, build only the SMALLER child of each
+    split over only that child's rows, and derive the sibling by
+    subtraction.  The cache holds int32 fixed-point digit sums
+    (ops/leafhist.py), so the subtraction is exact — stronger than the
+    reference's f64 accumulators (bin.h:25-27).  ``leaf_cache=False`` keeps
+    the one-full-pass-per-split strategy (used by tests needing bit-parity
+    with the distributed learners, which share that code path).
     """
+    leaf_cache: bool = True
 
     def reduce_sums(self, sums):
         return sums
 
-    def root_split(self, bins, g, h, w, root_g, root_h, root_c,
-                   num_bin, is_cat, feat_mask, max_bin: int, sp: SplitParams):
-        hist = root_histogram(bins, g, h, w, max_bin)
-        return find_best_split(hist, root_g, root_h, root_c, num_bin, is_cat,
-                               feat_mask, jnp.asarray(True), sp)
+    # -- per-tree preparation -------------------------------------------
+    def prepare(self, bins, bins_rm, g, h, w, params: "GrowParams"):
+        if not self.leaf_cache:
+            return None
+        from . import leafhist
+        if bins_rm is None:
+            bins_rm = bins.T
+        scales = leafhist.compute_scales(g, h, w)
+        digits = leafhist.quantize_digits(g, h, w, scales)
+        return _SerialPrep(bins_rm, digits, scales)
 
-    def children_splits(self, bins, g, h, w, leaf_id, parent_leaf, right_leaf,
+    def root_split(self, prep, bins, g, h, w, root_g, root_h, root_c,
+                   num_bin, is_cat, feat_mask, max_bin: int,
+                   sp: SplitParams, num_leaves: int):
+        if not self.leaf_cache:
+            hist = root_histogram(bins, g, h, w, max_bin)
+            split = find_best_split(hist, root_g, root_h, root_c, num_bin,
+                                    is_cat, feat_mask, jnp.asarray(True), sp)
+            return split, ()
+        from . import leafhist
+        F = bins.shape[0]
+        sums = leafhist.digit_histogram(prep.bins_rm, prep.digits, max_bin)
+        hist = leafhist.combine_digit_sums(sums, prep.scales)  # [F, B, 3]
+        split = find_best_split(hist, root_g, root_h, root_c, num_bin,
+                                is_cat, feat_mask, jnp.asarray(True), sp)
+        cache = jnp.zeros((num_leaves, F, 9, max_bin), jnp.int32)
+        cache = cache.at[0].set(sums)
+        return split, cache
+
+    def children_splits(self, prep, cache, bins, g, h, w, step: _StepInfo,
                         totals_g, totals_h, totals_c, can,
                         num_bin, is_cat, feat_mask, max_bin: int,
                         sp: SplitParams):
-        hists = children_histograms(bins, g, h, w, leaf_id,
-                                          parent_leaf, right_leaf, max_bin)
-        return find_best_split(hists, totals_g, totals_h, totals_c,
-                               num_bin, is_cat, feat_mask, can, sp)
+        if not self.leaf_cache:
+            hists = children_histograms(bins, g, h, w, step.leaf_id,
+                                        step.parent_leaf, step.right_leaf,
+                                        max_bin)
+            split = find_best_split(hists, totals_g, totals_h, totals_c,
+                                    num_bin, is_cat, feat_mask, can, sp)
+            return split, cache
+        from . import leafhist
+        N = step.leaf_id.shape[0]
+        classes = leafhist.size_classes(N)
+
+        # Raw (unweighted) row counts decide which child is smaller, like
+        # the reference's data-count rule (serial_tree_learner.cpp:404-420).
+        cnt_r = jnp.sum((step.in_leaf & step.go_right).astype(jnp.int32))
+        cnt_in = jnp.sum(step.in_leaf.astype(jnp.int32))
+        cnt_l = cnt_in - cnt_r
+        small_is_left = cnt_l <= cnt_r
+        mask_small = step.in_leaf & jnp.where(small_is_left, ~step.go_right,
+                                              step.go_right)
+        small_cnt = jnp.minimum(cnt_l, cnt_r)
+
+        sums_small = leafhist.leaf_histogram(prep.bins_rm, prep.digits,
+                                             mask_small, small_cnt,
+                                             max_bin, classes)
+        sums_parent = cache[step.parent_leaf]          # [F, 9, B] i32
+        sums_large = sums_parent - sums_small          # EXACT sibling
+        sums_left = jnp.where(small_is_left, sums_small, sums_large)
+        sums_right = jnp.where(small_is_left, sums_large, sums_small)
+
+        keep = step.do_split
+        cache = cache.at[step.parent_leaf].set(
+            jnp.where(keep, sums_left, sums_parent))
+        cache = cache.at[step.right_leaf].set(
+            jnp.where(keep, sums_right, cache[step.right_leaf]), mode="drop")
+
+        hists = leafhist.combine_digit_sums(
+            jnp.stack([sums_left, sums_right]), prep.scales)  # [2, F, B, 3]
+        split = find_best_split(hists, totals_g, totals_h, totals_c,
+                                num_bin, is_cat, feat_mask, can, sp)
+        return split, cache
 
 
 class GrowParams(NamedTuple):
@@ -143,7 +232,7 @@ def _store_leaf_split(state: _GrowState, leaf, split: BestSplit) -> _GrowState:
 
 @functools.partial(jax.jit, static_argnames=("params", "comm"))
 def grow_tree(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
-              learning_rate, params: GrowParams, comm=None):
+              learning_rate, params: GrowParams, comm=None, bins_rm=None):
     """Grow one tree.  All inputs are device arrays.
 
     Args:
@@ -156,17 +245,19 @@ def grow_tree(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
         gradient amplification).
       comm: static communication strategy (SerialComm by default; see
         lightgbm_tpu/parallel/comm.py for the distributed learners).
+      bins_rm: optional [N, F] row-major copy of bins for the cached serial
+        learner's gathers (derived by transposition when omitted).
     Returns (TreeArrays, leaf_id [N] i32, output_delta [N] f32) where
       output_delta = shrunk leaf value per row (the train-score update,
       serial_tree_learner AddPredictionToScore semantics).
     """
     return _grow_tree_impl(bins, num_bin, is_cat, feat_mask, grad, hess,
                            row_weight, learning_rate, params,
-                           comm or SerialComm())
+                           comm or SerialComm(), bins_rm)
 
 
 def _grow_tree_impl(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
-                    learning_rate, params: GrowParams, comm):
+                    learning_rate, params: GrowParams, comm, bins_rm=None):
     """Unjitted growth loop — callable inside shard_map."""
     L = params.num_leaves
     B = params.max_bin
@@ -179,9 +270,11 @@ def _grow_tree_impl(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
     root_g, root_h, root_c = comm.reduce_sums(
         (jnp.sum(g), jnp.sum(h), jnp.sum(row_weight)))
 
-    root_split = comm.root_split(bins, g, h, row_weight,
-                                 root_g, root_h, root_c,
-                                 num_bin, is_cat, feat_mask, B, sp)
+    prep = comm.prepare(bins, bins_rm, g, h, row_weight, params)
+    root_split, cache0 = comm.root_split(prep, bins, g, h, row_weight,
+                                         root_g, root_h, root_c,
+                                         num_bin, is_cat, feat_mask, B, sp,
+                                         L)
 
     neg_inf = jnp.full((L,), K_MIN_SCORE, dtype=jnp.float32)
     state = _GrowState(
@@ -209,7 +302,8 @@ def _grow_tree_impl(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
         internal_count=jnp.zeros((L - 1,), jnp.int32),
     )
 
-    def step(k, state: _GrowState) -> _GrowState:
+    def step(k, carry):
+        state, cache = carry
         # Best leaf by gain; ties -> first (smallest leaf idx), matching
         # ArrayArgs::ArgMax over SplitInfo (serial_tree_learner.cpp:204).
         best_leaf = jnp.argmax(state.best_gain).astype(jnp.int32)
@@ -298,8 +392,11 @@ def _grow_tree_impl(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
         totals_h = jnp.stack([left_h, right_h])
         totals_c = jnp.stack([left_c, right_c])
         can = jnp.stack([do_split & child_depth_ok] * 2)
-        child_split = comm.children_splits(
-            bins, g, h, row_weight, new_state.leaf_id, best_leaf, right_leaf,
+        info = _StepInfo(leaf_id=new_state.leaf_id, in_leaf=in_leaf,
+                         go_right=go_right, parent_leaf=best_leaf,
+                         right_leaf=right_leaf, do_split=do_split)
+        child_split, cache = comm.children_splits(
+            prep, cache, bins, g, h, row_weight, info,
             totals_g, totals_h, totals_c, can, num_bin, is_cat, feat_mask,
             B, sp)
 
@@ -329,9 +426,9 @@ def _grow_tree_impl(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
                       new_state.best_left_c[right_leaf]),
             right_rec)
         new_state = _store_leaf_split(new_state, right_leaf, store_right)
-        return new_state
+        return new_state, cache
 
-    state = jax.lax.fori_loop(0, L - 1, step, state)
+    state, _ = jax.lax.fori_loop(0, L - 1, step, (state, cache0))
 
     shrunk = state.cur_value * learning_rate
     tree = TreeArrays(

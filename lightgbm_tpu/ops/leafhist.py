@@ -1,0 +1,248 @@
+"""Leaf-proportional histogram construction with exact integer accumulation.
+
+This is the TPU replacement for the reference's two core histogram tricks
+(serial_tree_learner.cpp:398-453): build the histogram of only the *smaller*
+child of each split over only that child's rows, and derive the sibling by
+subtracting from the cached parent histogram (FeatureHistogram::Subtract,
+feature_histogram.hpp:62-68; cache = HistogramPool, :299-455).  Histogram
+cost per tree becomes O(N * depth) instead of the O(N * num_leaves) of a
+full-data pass per split.
+
+TPU-shaped design, three pieces:
+
+1. **Fixed-point quantization** (`quantize_digits`): per-tree scales map
+   gradient / hessian / weight to 24-bit fixed point, decomposed into three
+   balanced radix-256 int8 digits.  The histogram kernel then accumulates
+   int8 x int8(one-hot) products into int32 — *exact* integer arithmetic,
+   so the parent-minus-child subtraction is exact at any data scale.  This
+   replaces the reference's double-precision HistogramBinEntry accumulators
+   (bin.h:25-27): where f64 merely shrinks subtraction error, int32 sums
+   eliminate it.  Quantization error (half a step of scale * 2^-22 per row)
+   is of the same order as f32 input rounding.  Digit sums stay exact while
+   128 * rows_per_shard < 2^31, i.e. ~16M rows per device shard.
+
+2. **MXU one-hot kernel** (`_digit_hist_kernel`): for each row block, the
+   bin one-hot matrix is generated in VMEM (never HBM) per feature and
+   contracted against the digit block on the MXU.  Bins stream from HBM in
+   ROW-major uint8 (the cheap broadcast direction for the one-hot compare —
+   feature-major layout forces a lane->sublane relayout that dominates
+   runtime).  Measured ~10.5 ms for a full 1M x 28 x 256 pass on v5e.
+
+3. **Compaction + size-class dispatch** (`compact_rows`, `leaf_histogram`):
+   the smaller child's row indices are compacted with one cumsum pass, its
+   rows gathered, and the kernel run at a power-of-two padded size chosen
+   by `lax.switch` over static size classes — fixed shapes for XLA, work
+   proportional to the leaf.
+
+The scatter-add fallback (`hist_of_gathered_scatter`) keeps every piece
+runnable (and testable) on CPU with identical integer semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# 24-bit fixed point: values quantized to round(x / scale * 2^QBITS),
+# |q| <= 2^QBITS, decomposed into 3 balanced radix-256 int8 digits.
+QBITS = 22
+_DIGIT_W = (65536.0, 256.0, 1.0)
+NUM_STREAMS = 9  # 3 values (g, h, w) x 3 digits
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
+
+
+def compute_scales(g, h, w):
+    """Per-tree quantization scales [3] f32 (max |value| per stream)."""
+    return jnp.stack([
+        jnp.maximum(jnp.max(jnp.abs(g)), 1e-30),
+        jnp.maximum(jnp.max(jnp.abs(h)), 1e-30),
+        jnp.maximum(jnp.max(jnp.abs(w)), 1e-30),
+    ])
+
+
+def quantize_digits(g, h, w, scales):
+    """[N, 9] int8 balanced radix-256 digits of the 24-bit fixed-point
+    g/h/w.  Digit order: (g2, g1, g0, h2, h1, h0, w2, w1, w0) with weights
+    (65536, 256, 1); value = digits . weights * scale / 2^QBITS."""
+    vals = jnp.stack([g, h, w])                       # [3, N]
+    q = jnp.round(vals / scales[:, None]
+                  * float(1 << QBITS)).astype(jnp.int32)
+    d0 = ((q + 128) % 256) - 128                      # balanced low digit
+    q1 = (q - d0) // 256
+    d1 = ((q1 + 128) % 256) - 128
+    d2 = (q1 - d1) // 256                             # |d2| <= 65
+    digits = jnp.stack([d2, d1, d0], axis=1)          # [3, 3, N]
+    return digits.reshape(9, -1).T.astype(jnp.int8)   # [N, 9]
+
+
+def combine_digit_sums(sums_i32, scales):
+    """int32 digit sums [..., 9, B] -> f32 histogram [..., B, 3].
+
+    Exact up to one f32 rounding per entry: the digit sums themselves are
+    exact integers."""
+    s = sums_i32.astype(jnp.float32)
+    out = []
+    for v in range(3):
+        acc = (s[..., 3 * v, :] * _DIGIT_W[0]
+               + s[..., 3 * v + 1, :] * _DIGIT_W[1]
+               + s[..., 3 * v + 2, :] * _DIGIT_W[2])
+        out.append(acc * (scales[v] / float(1 << QBITS)))
+    return jnp.stack(out, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel: int8 digit histogram over row-major bins
+# ---------------------------------------------------------------------------
+
+def _digit_hist_kernel(bins_ref, dig_ref, out_ref, acc_ref, *, nb, f_blk, bb):
+    """Grid (row_blocks,): acc[f] += digits_blk^T-contracted one-hot.
+
+    bins_ref: [nb, f_blk] uint8/uint16 row-major block.
+    dig_ref:  [nb, 9] int8.
+    out/acc:  [f_blk, 9, bb] int32.
+    """
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    dig = dig_ref[:, :]                                    # [nb, 9] i8
+    iota = jax.lax.broadcasted_iota(jnp.int32, (nb, bb), 1)
+    for f in range(f_blk):
+        b_f = bins_ref[:, f].astype(jnp.int32)[:, None]    # [nb, 1]
+        onehot = (b_f == iota).astype(jnp.int8)            # [nb, bb]
+        # [9, bb] int32 = exact int8 x int8 MXU contraction over rows
+        part = jax.lax.dot_general(
+            dig, onehot, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        acc_ref[f] += part
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _flush():
+        out_ref[:] = acc_ref[:]
+
+
+def digit_histogram_pallas(bins_rm, digits, max_bin: int, n_blk: int = 8192,
+                           interpret: bool = False):
+    """[F, 9, B] int32 digit sums over ALL rows of bins_rm.
+
+    bins_rm: [S, F] uint8/uint16 row-major (S must be a multiple of n_blk
+    after internal padding); digits: [S, 9] int8 (pad rows must be zero).
+    """
+    S, F = bins_rm.shape
+    B = -(-max_bin // 128) * 128
+    nb = min(n_blk, S) if S % n_blk else n_blk
+    if S % nb:
+        pad = (-S) % nb
+        bins_rm = jnp.pad(bins_rm, ((0, pad), (0, 0)))
+        digits = jnp.pad(digits, ((0, pad), (0, 0)))
+        S += pad
+    out = pl.pallas_call(
+        functools.partial(_digit_hist_kernel, nb=nb, f_blk=F, bb=B),
+        grid=(S // nb,),
+        in_specs=[pl.BlockSpec((nb, F), lambda i: (i, 0)),
+                  pl.BlockSpec((nb, 9), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((F, 9, B), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, 9, B), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((F, 9, B), jnp.int32)],
+        interpret=interpret,
+    )(bins_rm, digits)
+    return out[:, :, :max_bin]
+
+
+def digit_histogram_scatter(bins_rm, digits, max_bin: int):
+    """CPU fallback with identical integer semantics: one scatter-add keyed
+    by (feature, bin) accumulating the 9 digit streams in int32."""
+    S, F = bins_rm.shape
+    B = max_bin
+    feat = jnp.arange(F, dtype=jnp.int32)[None, :]             # [1, F]
+    seg = feat * B + bins_rm.astype(jnp.int32)                 # [S, F]
+    out = jnp.zeros((F * B, 9), jnp.int32)
+    vals = jnp.broadcast_to(digits.astype(jnp.int32)[:, None, :],
+                            (S, F, 9)).reshape(-1, 9)
+    out = out.at[seg.reshape(-1)].add(vals, mode="drop")
+    return out.reshape(F, B, 9).transpose(0, 2, 1)             # [F, 9, B]
+
+
+def digit_histogram(bins_rm, digits, max_bin: int):
+    """Platform dispatcher for the all-rows digit histogram."""
+    if _on_tpu():
+        return digit_histogram_pallas(bins_rm, digits, max_bin)
+    return digit_histogram_scatter(bins_rm, digits, max_bin)
+
+
+# ---------------------------------------------------------------------------
+# Compaction + size-class dispatch
+# ---------------------------------------------------------------------------
+
+def size_classes(num_data: int, min_size: int = 8192) -> Sequence[int]:
+    """Static power-of-two compaction sizes covering [1, ceil(N/2)]."""
+    top = max(num_data + 1, 2) // 2
+    smax = 1
+    while smax < top:
+        smax *= 2
+    sizes = []
+    s = min(min_size, smax)
+    while s < smax:
+        sizes.append(s)
+        s *= 2
+    sizes.append(smax)
+    return tuple(sizes)
+
+
+def compact_rows(mask, size: int):
+    """Indices of the up-to-`size` True rows of mask, padded arbitrarily.
+
+    Returns (idx [size] i32, valid [size] bool).  One cumsum + one scatter,
+    O(N) elementwise work."""
+    n = mask.shape[0]
+    pos = jnp.cumsum(mask.astype(jnp.int32))
+    cnt = pos[-1]
+    idx = jnp.zeros((size,), jnp.int32)
+    idx = idx.at[jnp.where(mask, pos - 1, size)].set(
+        jnp.arange(n, dtype=jnp.int32), mode="drop")
+    valid = jnp.arange(size, dtype=jnp.int32) < cnt
+    return idx, valid
+
+
+def leaf_histogram(bins_rm, digits, mask, count, max_bin: int,
+                   classes: Sequence[int]):
+    """[F, 9, B] int32 digit sums over the rows selected by `mask`,
+    dispatched over static size classes so cost tracks the leaf size.
+
+    `count` must equal sum(mask) (precomputed by the caller, which already
+    has it from the partition step)."""
+    B = max_bin
+    F = bins_rm.shape[1]
+
+    def make_branch(size):
+        def branch(operands):
+            bins_rm, digits, mask = operands
+            idx, valid = compact_rows(mask, size)
+            gathered_bins = jnp.take(bins_rm, idx, axis=0)      # [size, F]
+            gathered_dig = jnp.take(digits, idx, axis=0)        # [size, 9]
+            gathered_dig = jnp.where(valid[:, None], gathered_dig, 0)
+            if _on_tpu():
+                return digit_histogram_pallas(gathered_bins, gathered_dig, B)
+            return digit_histogram_scatter(gathered_bins, gathered_dig, B)
+        return branch
+
+    branches = [make_branch(s) for s in classes]
+    if len(branches) == 1:
+        return branches[0]((bins_rm, digits, mask))
+    sizes_arr = jnp.asarray(classes, jnp.int32)
+    cls = jnp.sum(count > sizes_arr).astype(jnp.int32)
+    cls = jnp.minimum(cls, len(branches) - 1)
+    return jax.lax.switch(cls, branches, (bins_rm, digits, mask))

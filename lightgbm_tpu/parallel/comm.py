@@ -115,21 +115,27 @@ class DataParallelComm(NamedTuple):
         local = _offset_features(local, offset)
         return _allgather_combine(local, self.axis_name, k)
 
-    def root_split(self, bins, g, h, w, root_g, root_h, root_c,
-                   num_bin, is_cat, feat_mask, max_bin: int, sp: SplitParams):
+    def prepare(self, bins, bins_rm, g, h, w, params):
+        return None
+
+    def root_split(self, prep, bins, g, h, w, root_g, root_h, root_c,
+                   num_bin, is_cat, feat_mask, max_bin: int, sp: SplitParams,
+                   num_leaves: int):
         hist = root_histogram(bins, g, h, w, max_bin)
         return self._split_from_hist(hist, root_g, root_h, root_c,
                                      jnp.asarray(True), num_bin, is_cat,
-                                     feat_mask, sp)
+                                     feat_mask, sp), ()
 
-    def children_splits(self, bins, g, h, w, leaf_id, parent_leaf, right_leaf,
+    def children_splits(self, prep, cache, bins, g, h, w, step,
                         totals_g, totals_h, totals_c, can,
                         num_bin, is_cat, feat_mask, max_bin: int,
                         sp: SplitParams):
-        hists = children_histograms(bins, g, h, w, leaf_id,
-                                          parent_leaf, right_leaf, max_bin)
+        hists = children_histograms(bins, g, h, w, step.leaf_id,
+                                    step.parent_leaf, step.right_leaf,
+                                    max_bin)
         return self._split_from_hist(hists, totals_g, totals_h, totals_c,
-                                     can, num_bin, is_cat, feat_mask, sp)
+                                     can, num_bin, is_cat, feat_mask,
+                                     sp), cache
 
 
 class FeatureParallelComm(NamedTuple):
@@ -160,28 +166,34 @@ class FeatureParallelComm(NamedTuple):
         fm = lax.dynamic_slice_in_dim(feat_mask, offset, self.f_block)
         return offset, nb, ic, fm
 
-    def root_split(self, bins, g, h, w, root_g, root_h, root_c,
-                   num_bin, is_cat, feat_mask, max_bin: int, sp: SplitParams):
+    def prepare(self, bins, bins_rm, g, h, w, params):
+        return None
+
+    def root_split(self, prep, bins, g, h, w, root_g, root_h, root_c,
+                   num_bin, is_cat, feat_mask, max_bin: int, sp: SplitParams,
+                   num_leaves: int):
         offset, nb, ic, fm = self._local_meta(num_bin, is_cat, feat_mask)
         bins_blk = lax.dynamic_slice_in_dim(bins, offset, self.f_block, axis=0)
         hist = root_histogram(bins_blk, g, h, w, max_bin)
         local = find_best_split(hist, root_g, root_h, root_c, nb, ic, fm,
                                 jnp.asarray(True), sp)
         local = _offset_features(local, offset)
-        return _allgather_combine(local, self.axis_name, self.num_shards)
+        return _allgather_combine(local, self.axis_name, self.num_shards), ()
 
-    def children_splits(self, bins, g, h, w, leaf_id, parent_leaf, right_leaf,
+    def children_splits(self, prep, cache, bins, g, h, w, step,
                         totals_g, totals_h, totals_c, can,
                         num_bin, is_cat, feat_mask, max_bin: int,
                         sp: SplitParams):
         offset, nb, ic, fm = self._local_meta(num_bin, is_cat, feat_mask)
         bins_blk = lax.dynamic_slice_in_dim(bins, offset, self.f_block, axis=0)
-        hists = children_histograms(bins_blk, g, h, w, leaf_id,
-                                          parent_leaf, right_leaf, max_bin)
+        hists = children_histograms(bins_blk, g, h, w, step.leaf_id,
+                                    step.parent_leaf, step.right_leaf,
+                                    max_bin)
         local = find_best_split(hists, totals_g, totals_h, totals_c,
                                 nb, ic, fm, can, sp)
         local = _offset_features(local, offset)
-        return _allgather_combine(local, self.axis_name, self.num_shards)
+        return (_allgather_combine(local, self.axis_name, self.num_shards),
+                cache)
 
 
 class VotingParallelComm(NamedTuple):
@@ -204,8 +216,11 @@ class VotingParallelComm(NamedTuple):
         return _psum_tree(sums, self.axis_name)
 
     def _local_sp(self, sp: SplitParams) -> SplitParams:
+        # local_tree_config_.min_data_in_leaf /= num_machines_ is C++ INTEGER
+        # division (voting_parallel_tree_learner.cpp:52-54): floor, not a
+        # float scale; the hessian constraint is double and divides exactly.
         k = self.num_shards
-        return sp._replace(min_data_in_leaf=sp.min_data_in_leaf / k,
+        return sp._replace(min_data_in_leaf=int(sp.min_data_in_leaf) // k,
                            min_sum_hessian_in_leaf=(
                                sp.min_sum_hessian_in_leaf / k))
 
@@ -266,20 +281,26 @@ class VotingParallelComm(NamedTuple):
             feature=jnp.where(local_best.feature >= 0, real_feat,
                               local_best.feature))
 
-    def root_split(self, bins, g, h, w, root_g, root_h, root_c,
-                   num_bin, is_cat, feat_mask, max_bin: int, sp: SplitParams):
+    def prepare(self, bins, bins_rm, g, h, w, params):
+        return None
+
+    def root_split(self, prep, bins, g, h, w, root_g, root_h, root_c,
+                   num_bin, is_cat, feat_mask, max_bin: int, sp: SplitParams,
+                   num_leaves: int):
         hist = root_histogram(bins, g, h, w, max_bin)
         best = self._elect_and_split(
             hist[None], jnp.asarray([root_g]), jnp.asarray([root_h]),
             jnp.asarray([root_c]), jnp.asarray([True]),
             num_bin, is_cat, feat_mask, sp)
-        return jax.tree.map(lambda f: f[0], best)
+        return jax.tree.map(lambda f: f[0], best), ()
 
-    def children_splits(self, bins, g, h, w, leaf_id, parent_leaf, right_leaf,
+    def children_splits(self, prep, cache, bins, g, h, w, step,
                         totals_g, totals_h, totals_c, can,
                         num_bin, is_cat, feat_mask, max_bin: int,
                         sp: SplitParams):
-        hists = children_histograms(bins, g, h, w, leaf_id,
-                                          parent_leaf, right_leaf, max_bin)
+        hists = children_histograms(bins, g, h, w, step.leaf_id,
+                                    step.parent_leaf, step.right_leaf,
+                                    max_bin)
         return self._elect_and_split(hists, totals_g, totals_h, totals_c,
-                                     can, num_bin, is_cat, feat_mask, sp)
+                                     can, num_bin, is_cat, feat_mask,
+                                     sp), cache

@@ -1,0 +1,114 @@
+"""Leaf-proportional integer histogram engine (ops/leafhist.py):
+quantization round-trip, scatter/pallas parity, compaction, and the
+exact-subtraction property that replaces the reference's f64 accumulators
+(bin.h:25-27)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops import leafhist as lh
+
+
+def _data(n=5000, f=6, b=64, seed=0):
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    g = rng.normal(size=n).astype(np.float32) * 3
+    h = rng.uniform(0.05, 0.3, size=n).astype(np.float32)
+    w = (rng.uniform(size=n) < 0.8).astype(np.float32)
+    return bins, g, h, w
+
+
+def _ref_hist(bins, vals, b):
+    """f64 numpy reference histogram [F, B, 3]."""
+    f = bins.shape[1]
+    out = np.zeros((f, b, 3), np.float64)
+    for fi in range(f):
+        for v in range(3):
+            out[fi, :, v] = np.bincount(
+                bins[:, fi].astype(np.int64),
+                weights=vals[v].astype(np.float64), minlength=b)[:b]
+    return out
+
+
+def test_quantize_roundtrip():
+    _, g, h, w = _data()
+    scales = lh.compute_scales(jnp.asarray(g), jnp.asarray(h), jnp.asarray(w))
+    digits = np.asarray(lh.quantize_digits(
+        jnp.asarray(g), jnp.asarray(h), jnp.asarray(w), scales))
+    assert digits.shape == (g.size, 9) and digits.dtype == np.int8
+    sc = np.asarray(scales)
+    for v, x in enumerate([g, h, w]):
+        rec = (digits[:, 3 * v].astype(np.int64) * 65536
+               + digits[:, 3 * v + 1].astype(np.int64) * 256
+               + digits[:, 3 * v + 2]).astype(np.float64)
+        rec = rec * sc[v] / (1 << lh.QBITS)
+        np.testing.assert_allclose(rec, x, atol=sc[v] * 2.0**-lh.QBITS)
+
+
+def test_digit_histogram_matches_f64_reference():
+    b = 64
+    bins, g, h, w = _data(b=b)
+    scales = lh.compute_scales(jnp.asarray(g), jnp.asarray(h), jnp.asarray(w))
+    digits = lh.quantize_digits(jnp.asarray(g), jnp.asarray(h),
+                                jnp.asarray(w), scales)
+    sums = lh.digit_histogram(jnp.asarray(bins), digits, b)
+    hist = np.asarray(lh.combine_digit_sums(sums, scales))   # [F, B, 3]
+    hist = hist.transpose(0, 2, 1)                           # [F, 3, B]
+    ref = _ref_hist(bins, [g, h, w], b).transpose(0, 2, 1)
+    np.testing.assert_allclose(hist, ref, atol=2e-4 * np.abs(ref).max())
+
+
+def test_pallas_interpret_matches_scatter():
+    b = 128
+    bins, g, h, w = _data(n=4096, b=b)
+    scales = lh.compute_scales(jnp.asarray(g), jnp.asarray(h), jnp.asarray(w))
+    digits = lh.quantize_digits(jnp.asarray(g), jnp.asarray(h),
+                                jnp.asarray(w), scales)
+    via_scatter = np.asarray(
+        lh.digit_histogram_scatter(jnp.asarray(bins), digits, b))
+    via_pallas = np.asarray(lh.digit_histogram_pallas(
+        jnp.asarray(bins), digits, b, n_blk=1024, interpret=True))
+    # both are exact integer sums -> bit-identical
+    np.testing.assert_array_equal(via_scatter, via_pallas)
+
+
+def test_compact_rows():
+    rng = np.random.RandomState(3)
+    mask = jnp.asarray(rng.uniform(size=1000) < 0.3)
+    idx, valid = lh.compact_rows(mask, 512)
+    want = np.nonzero(np.asarray(mask))[0]
+    got = np.asarray(idx)[np.asarray(valid)]
+    np.testing.assert_array_equal(np.sort(got), want)
+
+
+def test_leaf_histogram_sizes_and_subtraction_exactness():
+    """Parent digit sums == left + right digit sums EXACTLY (int32), the
+    property the reference needs f64 for."""
+    b = 32
+    n = 20000
+    bins, g, h, w = _data(n=n, b=b, seed=7)
+    leaf = (np.random.RandomState(1).uniform(size=n) < 0.23)
+    scales = lh.compute_scales(jnp.asarray(g), jnp.asarray(h), jnp.asarray(w))
+    digits = lh.quantize_digits(jnp.asarray(g), jnp.asarray(h),
+                                jnp.asarray(w), scales)
+    classes = lh.size_classes(n, min_size=1024)
+    parent = lh.digit_histogram(jnp.asarray(bins), digits, b)
+    small = lh.leaf_histogram(jnp.asarray(bins), digits, jnp.asarray(leaf),
+                              jnp.asarray(leaf.sum(), jnp.int32), b, classes)
+    large = lh.leaf_histogram(jnp.asarray(bins), digits, jnp.asarray(~leaf),
+                              jnp.asarray((~leaf).sum(), jnp.int32), b,
+                              classes)
+    np.testing.assert_array_equal(np.asarray(parent),
+                                  np.asarray(small) + np.asarray(large))
+    # derived sibling == directly built sibling, exactly
+    np.testing.assert_array_equal(np.asarray(parent) - np.asarray(small),
+                                  np.asarray(large))
+
+
+def test_size_classes():
+    assert lh.size_classes(1_000_000) == (8192, 16384, 32768, 65536,
+                                          131072, 262144, 524288)
+    assert lh.size_classes(10000, min_size=1024) == (1024, 2048, 4096, 8192)
+    assert lh.size_classes(100, min_size=8192) == (64,)

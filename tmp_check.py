@@ -1,0 +1,92 @@
+"""Validate the suspicious 0.02ms result: correctness + honest timing."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N = 1_000_000
+F = 28
+B = 256
+
+rng = np.random.RandomState(0)
+bins_np = rng.randint(0, B, size=(N, F)).astype(np.uint8)
+bins_rm = jnp.asarray(bins_np)
+g = jnp.asarray(rng.normal(size=N), jnp.float32)
+h = jnp.asarray(rng.uniform(0.1, 0.3, size=N), jnp.float32)
+w = jnp.ones((N,), jnp.float32)
+
+
+def _kern(bins_ref, vals_ref, out_ref, acc_ref, *, nb, f_blk, bb):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    vals = vals_ref[:, :]
+    binz = bins_ref[:, :].astype(jnp.int32)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (nb, bb), 1)
+    for f in range(f_blk):
+        b_f = binz[:, f][:, None]
+        onehot = (b_f == iota).astype(jnp.bfloat16)
+        part = jax.lax.dot_general(
+            vals, onehot, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[f] += part
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+@jax.jit
+def root_pass(bins_rm, g, h, w):
+    nb = 8192
+    pad = (-N) % nb
+    b = jnp.pad(bins_rm, ((0, pad), (0, 0)))
+    vals = jnp.stack([jnp.pad(g, (0, pad)), jnp.pad(h, (0, pad)),
+                      jnp.pad(w, (0, pad))])
+    hi = vals.astype(jnp.bfloat16)
+    lo = (vals - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    vals6 = jnp.concatenate([hi, lo], 0)
+    S = N + pad
+    out = pl.pallas_call(
+        functools.partial(_kern, nb=nb, f_blk=F, bb=B),
+        grid=(S // nb,),
+        in_specs=[pl.BlockSpec((nb, F), lambda i: (i, 0)),
+                  pl.BlockSpec((6, nb), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((F, 6, B), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, 6, B), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((F, 6, B), jnp.float32)],
+    )(b, vals6)
+    return out[:, :3] + out[:, 3:]
+
+
+out = jax.block_until_ready(root_pass(bins_rm, g, h, w))
+out_np = np.asarray(out)
+
+# correctness vs numpy f64 bincount on 4 features
+ok = True
+for f in range(4):
+    for v, arr in enumerate([np.asarray(g), np.asarray(h), np.asarray(w)]):
+        ref = np.bincount(bins_np[:, f].astype(np.int64),
+                          weights=arr.astype(np.float64), minlength=B)
+        err = np.max(np.abs(out_np[f, v] - ref) / (np.abs(ref) + 1.0))
+        if err > 1e-5:
+            ok = False
+            print(f"f={f} v={v} rel err {err:.2e}")
+print("correct:", ok, flush=True)
+
+# honest timing: many reps, total wall clock
+for reps in (10, 100):
+    t0 = time.perf_counter()
+    outs = None
+    for _ in range(reps):
+        outs = root_pass(bins_rm, g, h, w)
+    jax.block_until_ready(outs)
+    dt = (time.perf_counter() - t0) / reps * 1000
+    print(f"reps={reps}: {dt:.3f} ms per call", flush=True)

@@ -1,0 +1,77 @@
+"""Isolate the row-major kernel bug: counts-only, small N, dtype variants."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N = 16384
+F = 28
+B = 256
+NB = 8192
+
+rng = np.random.RandomState(0)
+bins_np = rng.randint(0, B, size=(N, F)).astype(np.uint8)
+w = jnp.ones((N,), jnp.float32)
+
+
+def _kern(bins_ref, vals_ref, out_ref, acc_ref, *, nb, f_blk, bb, widen):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    vals = vals_ref[:, :]
+    binz = bins_ref[:, :]
+    if widen:
+        binz = binz.astype(jnp.int32)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (nb, bb), 1)
+    for f in range(f_blk):
+        b_f = binz[:, f][:, None].astype(jnp.int32)
+        onehot = (b_f == iota).astype(jnp.float32)
+        part = jax.lax.dot_general(
+            vals, onehot, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+        acc_ref[f] += part
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+def run(dtype, widen, interpret=False):
+    b = jnp.asarray(bins_np.astype(dtype))
+    vals = w[None]
+    out = pl.pallas_call(
+        functools.partial(_kern, nb=NB, f_blk=F, bb=B, widen=widen),
+        grid=(N // NB,),
+        in_specs=[pl.BlockSpec((NB, F), lambda i: (i, 0)),
+                  pl.BlockSpec((1, NB), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((F, 1, B), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, 1, B), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((F, 1, B), jnp.float32)],
+        interpret=interpret,
+    )(b, vals)
+    return np.asarray(out)[:, 0]
+
+
+ref = np.stack([np.bincount(bins_np[:, f].astype(np.int64), minlength=B)
+                for f in range(F)]).astype(np.float64)
+
+for dtype, widen, tag in [(np.uint8, True, "u8 widen-in-kern"),
+                          (np.int32, False, "i32 input"),
+                          (np.uint8, True, "u8 interp")]:
+    interp = tag.endswith("interp")
+    got = run(dtype, widen, interp)
+    bad = [f for f in range(F) if not np.allclose(got[f], ref[f])]
+    print(f"{tag:20s} bad features: {bad[:8]}{'...' if len(bad)>8 else ''} "
+          f"total_count_ok={np.allclose(got.sum(1), N)}", flush=True)
+    if bad:
+        f = bad[0]
+        d = got[f] - ref[f]
+        nz = np.nonzero(d)[0]
+        print(f"  f={f}: first diffs at bins {nz[:6]} delta {d[nz[:6]]}")

@@ -1,0 +1,136 @@
+"""Micro-benchmark: compaction (mask->cumsum->scatter) + row gather +
+variant-F kernel at child sizes S.  Throwaway exploration script."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N = 1_000_000
+F = 28
+B = 256
+
+rng = np.random.RandomState(0)
+bins_rm = jnp.asarray(rng.randint(0, B, size=(N, F)), jnp.uint8)
+g = jnp.asarray(rng.normal(size=N), jnp.float32)
+h = jnp.asarray(rng.uniform(0.1, 0.3, size=N), jnp.float32)
+w = jnp.ones((N,), jnp.float32)
+
+
+def timeit(name, fn, *args, reps=20):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps * 1000
+    print(f"{name:55s} {dt:8.3f} ms", flush=True)
+    return out
+
+
+def _kern(bins_ref, vals_ref, out_ref, acc_ref, *, nb, f_blk, bb):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    vals = vals_ref[:, :]                                   # [6, nb] bf16
+    binz = bins_ref[:, :].astype(jnp.int32)                 # [nb, F]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (nb, bb), 1)
+    for f in range(f_blk):
+        b_f = binz[:, f][:, None]
+        onehot = (b_f == iota).astype(jnp.bfloat16)
+        part = jax.lax.dot_general(
+            vals, onehot, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[f] += part
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+def hist_S(bins_s, vals6, S, nb):
+    nblocks = S // nb
+    return pl.pallas_call(
+        functools.partial(_kern, nb=nb, f_blk=F, bb=B),
+        grid=(nblocks,),
+        in_specs=[pl.BlockSpec((nb, F), lambda i: (i, 0)),
+                  pl.BlockSpec((6, nb), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((F, 6, B), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, 6, B), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((F, 6, B), jnp.float32)],
+    )(bins_s, vals6)
+
+
+@functools.partial(jax.jit, static_argnames=("S",))
+def child_pass(bins_rm, g, h, w, leaf_id, target, S):
+    """compact rows of `target` leaf (S static pad) + gather + kernel."""
+    mask = leaf_id == target
+    pos = jnp.cumsum(mask.astype(jnp.int32))
+    cnt = pos[-1]
+    idx = jnp.zeros((S,), jnp.int32)
+    idx = idx.at[jnp.where(mask, pos - 1, S)].set(
+        jnp.arange(N, dtype=jnp.int32), mode="drop")
+    gathered = bins_rm[idx]                                  # [S, F] u8
+    valid = (jnp.arange(S) < cnt).astype(jnp.float32)
+    gs, hs, ws = g[idx] * valid, h[idx] * valid, w[idx] * valid
+    vals = jnp.stack([gs, hs, ws])
+    hi = vals.astype(jnp.bfloat16)
+    lo = (vals - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    vals6 = jnp.concatenate([hi, lo], 0)
+    nb = min(8192, S)
+    out = hist_S(gathered, vals6, S, nb)
+    return out[:, :3] + out[:, 3:]
+
+
+@jax.jit
+def compact_only(leaf_id, target):
+    mask = leaf_id == target
+    pos = jnp.cumsum(mask.astype(jnp.int32))
+    S = N // 2
+    idx = jnp.zeros((S,), jnp.int32)
+    idx = idx.at[jnp.where(mask, pos - 1, S)].set(
+        jnp.arange(N, dtype=jnp.int32), mode="drop")
+    return idx
+
+
+@functools.partial(jax.jit, static_argnames=("S",))
+def gather_only(bins_rm, idx, S):
+    return bins_rm[idx[:S]]
+
+
+print("device:", jax.devices()[0])
+# leaf assignment where target leaf has ~S rows
+for frac, S in [(0.5, 524288), (0.25, 262144), (0.125, 131072),
+                (0.03125, 32768), (0.0078125, 8192)]:
+    leaf_id = jnp.asarray(
+        (rng.uniform(size=N) < frac).astype(np.int32) * 7, jnp.int32)
+    timeit(f"child_pass S={S:7d} (frac {frac})",
+           lambda L=leaf_id, S=S: child_pass(bins_rm, g, h, w, L, 7, S))
+
+leaf_id = jnp.asarray((rng.uniform(size=N) < 0.5).astype(np.int32) * 7)
+idx = compact_only(leaf_id, 7)
+timeit("compact_only (mask+cumsum+scatter @1M)", compact_only, leaf_id, 7)
+timeit("gather_only S=512k rows [S,28] u8", gather_only, bins_rm, idx, 524288)
+timeit("gather_only S=131k", gather_only, bins_rm, idx, 131072)
+
+# full pass (root, no gather) for comparison; pad N to a block multiple
+@jax.jit
+def root_pass(bins_rm, g, h, w):
+    nb = 8192
+    pad = (-N) % nb
+    b = jnp.pad(bins_rm, ((0, pad), (0, 0)))
+    vals = jnp.stack([jnp.pad(g, (0, pad)), jnp.pad(h, (0, pad)),
+                      jnp.pad(w, (0, pad))])
+    hi = vals.astype(jnp.bfloat16)
+    lo = (vals - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    vals6 = jnp.concatenate([hi, lo], 0)
+    out = hist_S(b, vals6, N + pad, nb)
+    return out[:, :3] + out[:, 3:]
+
+timeit("root full pass V=6 nb=8192 (padded)", root_pass, bins_rm, g, h, w)

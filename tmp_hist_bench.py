@@ -1,0 +1,310 @@
+"""Micro-benchmark of histogram kernel variants on the real TPU chip.
+
+Times the current production kernel plus redesign candidates, at
+1M x 28 x 256 (the bench shape).  Throwaway exploration script.
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N = 1_000_000
+F = 28
+B = 256
+
+rng = np.random.RandomState(0)
+bins_fm = jnp.asarray(rng.randint(0, B, size=(F, N)), jnp.int8)   # feature-major
+bins_rm = jnp.asarray(np.ascontiguousarray(np.asarray(bins_fm).T))  # row-major [N, F]
+g = jnp.asarray(rng.normal(size=N), jnp.float32)
+h = jnp.asarray(rng.uniform(0.1, 0.3, size=N), jnp.float32)
+w = jnp.ones((N,), jnp.float32)
+leaf = jnp.asarray(rng.randint(0, 2, size=N), jnp.int32)
+
+
+def timeit(name, fn, *args, reps=10):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps * 1000
+    print(f"{name:55s} {dt:8.2f} ms")
+    return out
+
+
+# --- current production kernel ------------------------------------------
+from lightgbm_tpu.ops.pallas_histogram import children_histograms_pallas
+
+timeit("current children_histograms_pallas (f32, per-f dot)",
+       lambda: children_histograms_pallas(bins_fm, g, h, w, leaf, 0, 1, 255))
+
+
+# --- variant A: fused one-hot over all features, one dot per block ------
+def _kern_fused(bins_ref, vals_ref, out_ref, acc_ref, *, nb, f_blk, bb):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    vals = vals_ref[:, :]                                  # [6, nb]
+    binz = bins_ref[:, :]                                  # [f_blk, nb] i32
+    iota = jax.lax.broadcasted_iota(jnp.int32, (nb, f_blk, bb), 2)
+    # onehot[i, f, b] = bins[f, i] == b  -> reshape [nb, f_blk*bb]
+    onehot = (binz.T[:, :, None] == iota).astype(jnp.float32)
+    onehot = onehot.reshape(nb, f_blk * bb)
+    acc_ref[:, :] += jax.lax.dot_general(
+        vals, onehot, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("nb",))
+def fused_f32(bins, g, h, w, leaf, nb=256):
+    is_l = (leaf == 0).astype(jnp.float32)
+    is_r = (leaf == 1).astype(jnp.float32)
+    vals = jnp.stack([g * is_l, h * is_l, w * is_l,
+                      g * is_r, h * is_r, w * is_r])
+    nblocks = N // nb
+    return pl.pallas_call(
+        functools.partial(_kern_fused, nb=nb, f_blk=F, bb=B),
+        grid=(nblocks,),
+        in_specs=[pl.BlockSpec((F, nb), lambda i: (0, i)),
+                  pl.BlockSpec((6, nb), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((6, F * B), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((6, F * B), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((6, F * B), jnp.float32)],
+    )(bins.astype(jnp.int32), vals)
+
+
+# --- variant B: per-feature dot but bf16 hi/lo split --------------------
+def _kern_bf16(bins_ref, vals_ref, out_ref, acc_ref, *, nb, f_blk, bb):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    vals = vals_ref[:, :]                                  # [12, nb] bf16
+    binz = bins_ref[:, :]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (nb, bb), 1)
+    for f in range(f_blk):
+        b_f = jax.lax.broadcast_in_dim(binz[f], (nb, bb), (0,))
+        onehot = (b_f == iota).astype(jnp.bfloat16)
+        part = jax.lax.dot_general(
+            vals, onehot, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [12, bb]
+        acc_ref[f] += part
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("nb",))
+def perf_bf16(bins, g, h, w, leaf, nb=2048):
+    is_l = (leaf == 0).astype(jnp.float32)
+    is_r = (leaf == 1).astype(jnp.float32)
+    vals = jnp.stack([g * is_l, h * is_l, w * is_l,
+                      g * is_r, h * is_r, w * is_r])       # [6, N] f32
+    hi = vals.astype(jnp.bfloat16)
+    lo = (vals - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    vals12 = jnp.concatenate([hi, lo], axis=0)             # [12, N] bf16
+    nblocks = N // nb
+    out = pl.pallas_call(
+        functools.partial(_kern_bf16, nb=nb, f_blk=F, bb=B),
+        grid=(nblocks,),
+        in_specs=[pl.BlockSpec((F, nb), lambda i: (0, i)),
+                  pl.BlockSpec((12, nb), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((F, 12, B), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, 12, B), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((F, 12, B), jnp.float32)],
+    )(bins.astype(jnp.int32), vals12)
+    return out[:, :6] + out[:, 6:]
+
+
+# --- variant C: like current but int8 bins widened in-kernel ------------
+def _kern_i8(bins_ref, vals_ref, out_ref, acc_ref, *, nb, f_blk, bb):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    vals = vals_ref[:, :]
+    binz = bins_ref[:, :].astype(jnp.int32)                # widen in VMEM
+    iota = jax.lax.broadcasted_iota(jnp.int32, (nb, bb), 1)
+    for f in range(f_blk):
+        b_f = jax.lax.broadcast_in_dim(binz[f], (nb, bb), (0,))
+        onehot = (b_f == iota).astype(jnp.float32)
+        part = jax.lax.dot_general(
+            vals, onehot, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+        acc_ref[f] += part
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("nb",))
+def perf_i8(bins, g, h, w, leaf, nb=2048):
+    is_l = (leaf == 0).astype(jnp.float32)
+    is_r = (leaf == 1).astype(jnp.float32)
+    vals = jnp.stack([g * is_l, h * is_l, w * is_l,
+                      g * is_r, h * is_r, w * is_r])
+    nblocks = N // nb
+    return pl.pallas_call(
+        functools.partial(_kern_i8, nb=nb, f_blk=F, bb=B),
+        grid=(nblocks,),
+        in_specs=[pl.BlockSpec((F, nb), lambda i: (0, i)),
+                  pl.BlockSpec((6, nb), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((F, 6, B), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, 6, B), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((F, 6, B), jnp.float32)],
+    )(bins, vals)
+
+
+# --- variant D: bf16 hi/lo + int8 bins ----------------------------------
+@functools.partial(jax.jit, static_argnames=("nb",))
+def bf16_i8(bins, g, h, w, leaf, nb=2048):
+    is_l = (leaf == 0).astype(jnp.float32)
+    is_r = (leaf == 1).astype(jnp.float32)
+    vals = jnp.stack([g * is_l, h * is_l, w * is_l,
+                      g * is_r, h * is_r, w * is_r])
+    hi = vals.astype(jnp.bfloat16)
+    lo = (vals - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    vals12 = jnp.concatenate([hi, lo], axis=0)
+
+    def kern(bins_ref, vals_ref, out_ref, acc_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        vals = vals_ref[:, :]
+        binz = bins_ref[:, :].astype(jnp.int32)
+        iota = jax.lax.broadcasted_iota(jnp.int32, (nb, B), 1)
+        for f in range(F):
+            b_f = jax.lax.broadcast_in_dim(binz[f], (nb, B), (0,))
+            onehot = (b_f == iota).astype(jnp.bfloat16)
+            part = jax.lax.dot_general(
+                vals, onehot, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            acc_ref[f] += part
+
+        @pl.when(i == pl.num_programs(0) - 1)
+        def _():
+            out_ref[:] = acc_ref[:]
+
+    nblocks = N // nb
+    out = pl.pallas_call(
+        kern,
+        grid=(nblocks,),
+        in_specs=[pl.BlockSpec((F, nb), lambda i: (0, i)),
+                  pl.BlockSpec((12, nb), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((F, 12, B), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, 12, B), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((F, 12, B), jnp.float32)],
+    )(bins, vals12)
+    return out[:, :6] + out[:, 6:]
+
+
+# --- variant E: ROW-MAJOR bins [nb, F]; col-slice puts rows on sublanes,
+# --- broadcast across B lanes is the cheap direction ---------------------
+def _kern_rm(bins_ref, vals_ref, out_ref, acc_ref, *, nb, f_blk, bb, prec):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    vals = vals_ref[:, :]                                   # [V, nb]
+    binz = bins_ref[:, :].astype(jnp.int32)                 # [nb, F]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (nb, bb), 1)
+    dt = jnp.float32 if prec else jnp.bfloat16
+    for f in range(f_blk):
+        b_f = binz[:, f][:, None]                           # [nb, 1] sublanes
+        onehot = (b_f == iota).astype(dt)                   # lane-broadcast
+        part = jax.lax.dot_general(
+            vals, onehot, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST if prec else None)
+        acc_ref[f] += part
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("nb", "prec"))
+def rowmajor(bins_rm, g, h, w, leaf, nb=2048, prec=True):
+    is_l = (leaf == 0).astype(jnp.float32)
+    is_r = (leaf == 1).astype(jnp.float32)
+    vals = jnp.stack([g * is_l, h * is_l, w * is_l,
+                      g * is_r, h * is_r, w * is_r])
+    if prec:
+        valsx = vals
+        V = 6
+    else:
+        hi = vals.astype(jnp.bfloat16)
+        lo = (vals - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        valsx = jnp.concatenate([hi, lo], axis=0)
+        V = 12
+    nblocks = N // nb
+    out = pl.pallas_call(
+        functools.partial(_kern_rm, nb=nb, f_blk=F, bb=B, prec=prec),
+        grid=(nblocks,),
+        in_specs=[pl.BlockSpec((nb, F), lambda i: (i, 0)),
+                  pl.BlockSpec((V, nb), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((F, V, B), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, V, B), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((F, V, B), jnp.float32)],
+    )(bins_rm, valsx)
+    if prec:
+        return out
+    return out[:, :6] + out[:, 6:]
+
+
+print("device:", jax.devices()[0])
+r4 = timeit("E row-major int8 f32 (nb=2048)",
+            lambda: rowmajor(bins_rm, g, h, w, leaf, prec=True))
+r5 = timeit("F row-major int8 bf16 hi/lo (nb=2048)",
+            lambda: rowmajor(bins_rm, g, h, w, leaf, prec=False))
+r6 = timeit("E row-major nb=8192",
+            lambda: rowmajor(bins_rm, g, h, w, leaf, nb=8192, prec=True))
+r7 = timeit("F row-major bf16 nb=8192",
+            lambda: rowmajor(bins_rm, g, h, w, leaf, nb=8192, prec=False))
+r0 = timeit("A fused onehot f32 (nb=1024)", fused_f32, bins_fm, g, h, w, leaf)
+r1 = timeit("B per-f dot bf16 hi/lo (nb=2048)", perf_bf16, bins_fm, g, h, w, leaf)
+r2 = timeit("C per-f dot f32, int8 bins in-kernel", perf_i8, bins_fm, g, h, w, leaf)
+r3 = timeit("D per-f dot bf16 hi/lo + int8 bins", bf16_i8, bins_fm, g, h, w, leaf)
+
+# correctness cross-check vs numpy on a small slice
+ref = np.zeros((F, 6, B), np.float64)
+bn = np.asarray(bins_fm).astype(np.uint8)
+vals = np.stack([np.asarray(g) * (np.asarray(leaf) == 0),
+                 np.asarray(h) * (np.asarray(leaf) == 0),
+                 np.asarray(w) * (np.asarray(leaf) == 0),
+                 np.asarray(g) * (np.asarray(leaf) == 1),
+                 np.asarray(h) * (np.asarray(leaf) == 1),
+                 np.asarray(w) * (np.asarray(leaf) == 1)])
+for f in range(2):
+    for v in range(6):
+        ref[f, v] = np.bincount(bn[f].astype(np.int64), weights=vals[v],
+                                minlength=B)[:B]
+for name, r in [("B", np.asarray(r1)), ("C", np.asarray(r2)),
+                ("D", np.asarray(r3)), ("E", np.asarray(r4)),
+                ("F", np.asarray(r5))]:
+    err = np.max(np.abs(r[:2] - ref[:2]) / (np.abs(ref[:2]) + 1))
+    print(f"variant {name} max rel err vs f64: {err:.3e}")
