@@ -21,6 +21,9 @@ from .tree import Tree
 
 class DART(GBDT):
     submodel_name = "dart"
+    # Normalize reads/rewrites this iteration's host trees immediately
+    # after training, so DART cannot run the one-iteration-behind pipeline.
+    _pipeline = False
 
     def __init__(self, config, train_set, objective=None):
         super().__init__(config, train_set, objective)

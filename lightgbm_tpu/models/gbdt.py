@@ -20,7 +20,8 @@ from ..config import Config
 from ..io.dataset import BinnedDataset
 from ..metric import Metric, create_metric
 from ..objective import ObjectiveFunction, create_objective
-from ..ops.grow import GrowParams, grow_tree
+from ..ops.grow import (GrowParams, grow_tree, pack_tree_arrays,
+                        unpack_tree_arrays)
 from ..ops.predict import predict_binned_forest, predict_binned_tree
 from ..utils import log
 from .tree import Tree
@@ -58,9 +59,22 @@ class _DeviceData:
 
 
 class GBDT:
-    """Gradient Boosting Decision Tree (reference gbdt.h:20-351)."""
+    """Gradient Boosting Decision Tree (reference gbdt.h:20-351).
+
+    Training is PIPELINED: ``train_one_iter`` materializes the *previous*
+    iteration's trees (one batched device->host transfer) and then
+    dispatches this iteration's device work, so the host never blocks on
+    the iteration it just dispatched and per-field sync round-trips are
+    gone.  ``models`` is a property that flushes the pending iteration, so
+    every reader sees the synchronous view.  Subclasses needing tree bodies
+    right after training (DART's Normalize) set ``_pipeline = False``.
+    """
 
     submodel_name = "gbdt"
+    _pipeline = True
+    _pending_iter = None          # [tree_arrays] of the last iteration
+    _pending_shrinkage = 1.0
+    _no_more_splits = False
 
     def __init__(self, config: Config, train_set: Optional[BinnedDataset],
                  objective: Optional[ObjectiveFunction] = None):
@@ -104,7 +118,11 @@ class GBDT:
         self._feature_rng = np.random.RandomState(cfg.feature_fraction_seed)
         self._row_weight = jnp.ones(self.num_data, jnp.float32)
         self._grad_fn = jax.jit(self.objective.gradients)
+        self._pack_fn = jax.jit(pack_tree_arrays)
         self._grow_fn = self._make_grow_fn()
+        # device-constant caches (avoid a host->device transfer per iter)
+        self._full_feat_mask = jnp.ones(self.num_features, bool)
+        self._lr_cache: Tuple[float, jax.Array] = (-1.0, jnp.float32(0))
 
     @staticmethod
     def _make_grow_params(cfg: Config) -> GrowParams:
@@ -158,6 +176,9 @@ class GBDT:
         old_cfg, self.config = getattr(self, "config", None), config
         if not hasattr(self, "train_set"):
             return
+        # The pending iteration was packed under the OLD grow_params; it must
+        # be unpacked with them before num_leaves can change.
+        self._flush_pending()
         self.shrinkage_rate = config.learning_rate
         new_params = self._make_grow_params(config)
         if new_params != self.grow_params or (
@@ -209,7 +230,7 @@ class GBDT:
         """feature_fraction sampling per tree (serial_tree_learner.cpp:226+)."""
         frac = self.config.feature_fraction
         if frac >= 1.0:
-            return jnp.ones(self.num_features, bool)
+            return self._full_feat_mask
         used = max(1, int(self.num_features * frac))
         idx = self._feature_rng.choice(self.num_features, used, replace=False)
         mask = np.zeros(self.num_features, bool)
@@ -220,45 +241,120 @@ class GBDT:
     def _gradients(self) -> Tuple[jax.Array, jax.Array]:
         return self._grad_fn(self.train_data.score)
 
+    # -- pipelined host materialization --------------------------------
+    @property
+    def models(self) -> List[Tree]:
+        """Host trees, class-major rows.  Flushes the pending iteration so
+        external readers (save/predict/DART/R bindings) always see the
+        synchronous view."""
+        self._flush_pending()
+        return self._models
+
+    @models.setter
+    def models(self, value: List[Tree]) -> None:
+        self._flush_pending()
+        self._models = value
+
+    def _flush_pending(self) -> None:
+        """Materialize the pending iteration's trees.  The 13 TreeArrays
+        fields travel as TWO packed vectors per class (device->host
+        round-trips are ~10ms each over a remote device link).  Detects
+        reference-style saturation (GBDT::TrainOneIter, gbdt.cpp:362-378):
+        an iteration where no class could split is popped and marks
+        training stopped."""
+        pend = self._pending_iter
+        if not pend:
+            return
+        self._pending_iter = None
+        host = jax.device_get([packed for packed, _, _ in pend])
+        L = self.grow_params.num_leaves
+        trees = [Tree.from_arrays(unpack_tree_arrays(iv, fv, L),
+                                  self.train_set.mappers,
+                                  self.train_set.used_feature_map,
+                                  self._pending_shrinkage)
+                 for iv, fv in host]
+        if all(t.num_leaves <= 1 for t in trees):
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements.")
+            self._no_more_splits = True
+            self.iter_ -= 1
+        else:
+            self._models.extend(trees)
+
     def train_one_iter(self, grad=None, hess=None) -> bool:
         """One boosting round (gbdt.cpp:295-382).  Returns True when training
-        should stop (no more splits possible on every class)."""
+        should stop (no more splits possible on every class).
+
+        With ``_pipeline`` the saturation signal arrives one call later than
+        the reference's (the saturated iteration is detected when the NEXT
+        call flushes it, AFTER that call has dispatched its own device work
+        — the dispatch must come first so the host transfer overlaps device
+        growth).  The resulting model and scores are identical: a saturated
+        iteration's trees are 1-leaf with value 0 (_GrowState.cur_value is
+        only written on splits), so their score deltas are exactly zero; the
+        trees are popped like GBDT::TrainOneIter's pop (gbdt.cpp:362-378),
+        and the extra dispatched iteration is discarded with its (possibly
+        nonzero, under bagging) deltas subtracted back out.  The only
+        observable deviation from the reference is one extra eval/callback
+        round for the popped iteration, with metrics unchanged from the
+        round before.  The flag is cleared on detection so an explicit retry
+        re-attempts growth, as the reference would."""
         if grad is None or hess is None:
             grad, hess = self._gradients()
         else:
             grad = jnp.asarray(grad, jnp.float32).reshape(self.num_class, -1)
             hess = jnp.asarray(hess, jnp.float32).reshape(self.num_class, -1)
         row_weight = self._bagging_mask(self.iter_)
-        could_split_any = False
+        if self._lr_cache[0] != self.shrinkage_rate:
+            self._lr_cache = (self.shrinkage_rate,
+                              jnp.float32(self.shrinkage_rate))
+        lr_dev = self._lr_cache[1]
+        cur = []
         for cls in range(self.num_class):
             feat_mask = self._feature_mask()
             tree_arrays, leaf_id, delta = self._grow_fn(
                 self.train_data.bins, self.num_bin, self.is_cat, feat_mask,
-                grad[cls], hess[cls], row_weight,
-                jnp.float32(self.shrinkage_rate))
+                grad[cls], hess[cls], row_weight, lr_dev)
             self.train_data.score = self.train_data.score.at[cls].add(delta)
-            host_tree = Tree.from_arrays(
-                tree_arrays, self.train_set.mappers,
-                self.train_set.used_feature_map,
-                self.shrinkage_rate)
-            if host_tree.num_leaves > 1:
-                could_split_any = True
-            self.models.append(host_tree)
+            vdeltas = []
             for dd in self.valid_data:
-                self._add_device_tree_to(dd, tree_arrays, cls)
+                vd = self._device_tree_delta(dd, tree_arrays)
+                dd.score = dd.score.at[cls].add(vd)
+                vdeltas.append(vd)
+            cur.append((self._pack_fn(tree_arrays), delta, vdeltas))
         self.iter_ += 1
-        if not could_split_any:
-            log.warning("Stopped training because there are no more leaves "
-                        "that meet the split requirements.")
-            # drop the useless constant trees of this iteration
-            for _ in range(self.num_class):
-                self.models.pop()
+        shrink = self.shrinkage_rate
+        if not self._pipeline:
+            self._pending_iter = cur
+            self._pending_shrinkage = shrink
+            self._flush_pending()
+            if self._no_more_splits:
+                self._no_more_splits = False
+                return True
+            return False
+        # Materialize the PREVIOUS iteration while the device runs this one.
+        # If it saturated, the reference would never have trained this
+        # iteration: undo its score deltas and discard it.
+        self._flush_pending()
+        if self._no_more_splits:
+            self._no_more_splits = False
+            for cls, (_, delta, vds) in enumerate(cur):
+                self.train_data.score = \
+                    self.train_data.score.at[cls].add(-delta)
+                for dd, vd in zip(self.valid_data, vds):
+                    dd.score = dd.score.at[cls].add(-vd)
             self.iter_ -= 1
             return True
+        self._pending_iter = cur
+        self._pending_shrinkage = shrink
         return False
 
     def rollback_one_iter(self) -> None:
         """GBDT::RollbackOneIter (gbdt.cpp:384-402)."""
+        # Flush BEFORE the iter_ guard: a pending saturated iteration is
+        # popped by the flush (decrementing iter_), and rolling back must
+        # target the last REAL iteration.
+        self._flush_pending()
         if self.iter_ <= 0:
             return
         for cls in reversed(range(self.num_class)):
@@ -271,14 +367,14 @@ class GBDT:
         self.iter_ -= 1
 
     # ------------------------------------------------------------------
-    def _add_device_tree_to(self, dd: _DeviceData, tree_arrays, cls: int):
+    def _device_tree_delta(self, dd: _DeviceData, tree_arrays) -> jax.Array:
         delta, _ = predict_binned_tree(
             tree_arrays.split_feature, tree_arrays.split_bin,
             self.is_cat[jnp.maximum(tree_arrays.split_feature, 0)],
             tree_arrays.left_child, tree_arrays.right_child,
             tree_arrays.leaf_value, dd.bins,
             self.grow_params.num_leaves)
-        dd.score = dd.score.at[cls].add(delta)
+        return delta
 
     def _add_host_tree_to(self, dd: _DeviceData, tree: Tree, cls: int):
         if tree.num_leaves <= 1:

@@ -191,6 +191,39 @@ class TreeArrays(NamedTuple):
     leaf_depth: jax.Array          # [L] i32
 
 
+def pack_tree_arrays(ta: "TreeArrays"):
+    """Pack TreeArrays into (ints, floats) vectors so a host fetch is TWO
+    transfers instead of 13 (each device->host round-trip costs ~10ms over
+    a remote device link; see GBDT._flush_pending)."""
+    ints = jnp.concatenate([
+        ta.num_leaves.reshape(1), ta.split_feature, ta.split_bin,
+        ta.left_child, ta.right_child, ta.internal_count,
+        ta.leaf_count, ta.leaf_parent, ta.leaf_depth])
+    flts = jnp.concatenate([ta.split_gain, ta.internal_value, ta.leaf_value])
+    return ints, flts
+
+
+def unpack_tree_arrays(ints, flts, num_leaves: int) -> "TreeArrays":
+    """Inverse of pack_tree_arrays, on host numpy arrays."""
+    L, n = num_leaves, num_leaves - 1
+    io, fo = 1, 0
+    out_i = []
+    for k in (n, n, n, n, n, L, L, L):
+        out_i.append(ints[io:io + k])
+        io += k
+    out_f = []
+    for k in (n, n, L):
+        out_f.append(flts[fo:fo + k])
+        fo += k
+    sf, sb, lc, rc, icnt, leaf_cnt, leaf_par, leaf_dep = out_i
+    sg, ival, lval = out_f
+    return TreeArrays(num_leaves=ints[0], split_feature=sf, split_bin=sb,
+                      split_gain=sg, left_child=lc, right_child=rc,
+                      internal_value=ival, internal_count=icnt,
+                      leaf_value=lval, leaf_count=leaf_cnt,
+                      leaf_parent=leaf_par, leaf_depth=leaf_dep)
+
+
 class _GrowState(NamedTuple):
     leaf_id: jax.Array             # [N] i32
     num_leaves: jax.Array          # scalar i32
@@ -253,7 +286,7 @@ def grow_tree(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
     """
     return _grow_tree_impl(bins, num_bin, is_cat, feat_mask, grad, hess,
                            row_weight, learning_rate, params,
-                           comm or SerialComm(), bins_rm)
+                           SerialComm() if comm is None else comm, bins_rm)
 
 
 def _grow_tree_impl(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,

@@ -29,10 +29,11 @@ TPU-shaped design, three pieces:
    runtime).  Measured ~10.5 ms for a full 1M x 28 x 256 pass on v5e.
 
 3. **Compaction + size-class dispatch** (`compact_rows`, `leaf_histogram`):
-   the smaller child's row indices are compacted with one cumsum pass, its
-   rows gathered, and the kernel run at a power-of-two padded size chosen
-   by `lax.switch` over static size classes — fixed shapes for XLA, work
-   proportional to the leaf.
+   the smaller child's row indices are compacted with one stable
+   key/payload sort (selected rows first — see compact_rows for why sort
+   beats scatter on TPU), its rows gathered, and the kernel run at a
+   power-of-two padded size chosen by `lax.switch` over static size
+   classes — fixed shapes for XLA, work proportional to the leaf.
 
 The scatter-add fallback (`hist_of_gathered_scatter`) keeps every piece
 runnable (and testable) on CPU with identical integer semantics.
@@ -205,14 +206,17 @@ def size_classes(num_data: int, min_size: int = 8192) -> Sequence[int]:
 def compact_rows(mask, size: int):
     """Indices of the up-to-`size` True rows of mask, padded arbitrarily.
 
-    Returns (idx [size] i32, valid [size] bool).  One cumsum + one scatter,
-    O(N) elementwise work."""
+    Returns (idx [size] i32, valid [size] bool).  Implemented as a stable
+    key/payload sort (selected rows first): XLA's TPU sort runs this ~4x
+    faster than the equivalent 1M-update scatter, which lowers to a
+    serialized loop (measured 1.7ms vs 6.3ms per call at N=1M in the grow
+    loop — the scatter was the single largest cost of the cached learner)."""
     n = mask.shape[0]
-    pos = jnp.cumsum(mask.astype(jnp.int32))
-    cnt = pos[-1]
-    idx = jnp.zeros((size,), jnp.int32)
-    idx = idx.at[jnp.where(mask, pos - 1, size)].set(
-        jnp.arange(n, dtype=jnp.int32), mode="drop")
+    cnt = jnp.sum(mask.astype(jnp.int32))
+    key = (~mask).astype(jnp.uint8)
+    _, idx_sorted = jax.lax.sort(
+        (key, jnp.arange(n, dtype=jnp.int32)), num_keys=1, is_stable=True)
+    idx = jax.lax.slice(idx_sorted, (0,), (size,))
     valid = jnp.arange(size, dtype=jnp.int32) < cnt
     return idx, valid
 

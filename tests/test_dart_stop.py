@@ -98,3 +98,55 @@ def test_voting_constraint_floor_division():
                                     min_sum_hessian_in_leaf=6.0))
     assert sp.min_data_in_leaf == 1          # 7 // 4, not 1.75
     assert sp.min_sum_hessian_in_leaf == pytest.approx(1.5)
+
+
+def test_rollback_with_pending_saturated_iteration():
+    """rollback_one_iter must flush the pending (pipelined) iteration BEFORE
+    its iter_ guard: a pending saturated iteration is popped by the flush,
+    and rollback must then target the last REAL iteration (or no-op when
+    none exists), not crash or double-pop."""
+    from lightgbm_tpu.models.gbdt import GBDT
+    X, y = _small_ds(n=100)
+    cfg = Config({"objective": "regression", "num_leaves": 7,
+                  "min_gain_to_split": 1e12, "metric": "none"})
+    ds = BinnedDataset.from_matrix(X, y, max_bin=32, min_data_in_leaf=10)
+    b = GBDT(cfg, ds)
+    assert b.train_one_iter() is False          # saturated iter is pending
+    b.rollback_one_iter()                       # must not raise
+    assert b.iter_ == 0 and len(b.models) == 0
+
+    # with one real iteration first: rollback pops THAT one exactly once
+    cfg2 = Config({"objective": "regression", "num_leaves": 7,
+                   "metric": "none"})
+    b2 = GBDT(cfg2, ds)
+    b2.train_one_iter()
+    b2.config.min_gain_to_split = 1e12          # saturate future growth
+    b2.reset_config(b2.config)
+    b2.train_one_iter()                         # real iter flushed, new pend
+    b2.train_one_iter()
+    b2.rollback_one_iter()
+    assert b2.iter_ == 0 and len(b2.models) == 0
+
+
+def test_reset_config_flushes_before_num_leaves_change():
+    """A pending iteration is packed under the OLD num_leaves; reset_config
+    must flush it before swapping grow_params, else the packed vectors are
+    unpacked at the wrong offsets (garbage trees)."""
+    from lightgbm_tpu.models.gbdt import GBDT
+    X, y = _small_ds(n=300)
+    cfg = Config({"objective": "regression", "num_leaves": 15,
+                  "metric": "none", "min_data_in_leaf": 10})
+    ds = BinnedDataset.from_matrix(X, y, max_bin=32, min_data_in_leaf=10)
+    b = GBDT(cfg, ds)
+    b.train_one_iter()                          # pending, packed with L=15
+    cfg2 = Config({"objective": "regression", "num_leaves": 5,
+                   "metric": "none", "min_data_in_leaf": 10})
+    b.reset_config(cfg2)                        # must flush with L=15
+    b.train_one_iter()
+    trees = b.models
+    assert len(trees) == 2
+    assert 1 < trees[0].num_leaves <= 15
+    assert 1 < trees[1].num_leaves <= 5
+    # leaf values of the first tree must be sane (not misaligned garbage)
+    assert np.all(np.isfinite(trees[0].leaf_value))
+    assert np.max(np.abs(trees[0].leaf_value)) < 100
