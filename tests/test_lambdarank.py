@@ -1,0 +1,80 @@
+"""Lambdarank size-class bucketing (objective/__init__.py): per-class
+padding must not change the math — gradients are identical to padding
+every query to the global maximum, and per-query lambda sums are zero
+(pairwise antisymmetry, rank_objective.hpp:83-137)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import Metadata
+from lightgbm_tpu.objective import LambdarankNDCG
+
+
+def _make(seed=0):
+    rng = np.random.RandomState(seed)
+    # heavily skewed query sizes: 17 small, one big (pad classes 4x apart)
+    sizes = [5, 9, 17, 33] * 4 + [210]
+    n = sum(sizes)
+    label = rng.randint(0, 4, size=n).astype(np.float32)
+    md = Metadata(n)
+    md.set_label(label)
+    md.set_query(np.asarray(sizes))
+    score = rng.normal(size=(1, n)).astype(np.float32)
+    return md, n, score
+
+
+def test_bucketing_matches_single_class_padding(monkeypatch):
+    md, n, score = _make()
+    cfg = Config({"objective": "lambdarank"})
+
+    obj = LambdarankNDCG(cfg)
+    obj.init(md, n)
+    assert len(obj.query_classes) > 1    # bucketing actually happened
+    g1, h1 = obj.gradients(score)
+
+    # force one global class: re-pad every bucket to the same width
+    big = 256
+    obj3 = LambdarankNDCG(cfg)
+    obj3.init(md, n)
+    import jax.numpy as jnp
+    merged_idx, merged_valid, merged_label, merged_inv = [], [], [], []
+    for cls in obj3.query_classes:
+        P = cls["P"]
+        pad = big - P
+        merged_idx.append(np.pad(np.asarray(cls["doc_idx"]),
+                                 ((0, 0), (0, pad))))
+        merged_valid.append(np.pad(np.asarray(cls["doc_valid"]),
+                                   ((0, 0), (0, pad))))
+        merged_label.append(np.pad(np.asarray(cls["label"]),
+                                   ((0, 0), (0, pad))))
+        merged_inv.append(np.asarray(cls["inv_max_dcg"]))
+    obj3.query_classes = [{
+        "P": big,
+        "doc_idx": jnp.asarray(np.concatenate(merged_idx)),
+        "doc_valid": jnp.asarray(np.concatenate(merged_valid)),
+        "label": jnp.asarray(np.concatenate(merged_label)),
+        "inv_max_dcg": jnp.asarray(np.concatenate(merged_inv)),
+    }]
+    g2, h2 = obj3.gradients(score)
+
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h2),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_per_query_lambda_sum_is_zero():
+    md, n, score = _make(seed=3)
+    cfg = Config({"objective": "lambdarank"})
+    obj = LambdarankNDCG(cfg)
+    obj.init(md, n)
+    g, h = obj.gradients(score)
+    g = np.asarray(g)[0]
+    h = np.asarray(h)[0]
+    qb = np.asarray(md.query_boundaries)
+    for q in range(len(qb) - 1):
+        seg = g[qb[q]:qb[q + 1]]
+        np.testing.assert_allclose(seg.sum(), 0.0, atol=1e-4)
+    assert np.all(h >= 0)
+    assert np.isfinite(g).all() and np.isfinite(h).all()
