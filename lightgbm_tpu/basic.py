@@ -357,15 +357,18 @@ class Booster:
         return np.asarray(dd.score, np.float64).reshape(-1)
 
     def __eval_at(self, data_idx: int, name: str, feval=None):
+        from .utils import timetag
         b = self._booster
         out = []
         metrics = (b.train_metrics if data_idx == 0
                    else b.valid_metrics[data_idx - 1])
         dd = b.train_data if data_idx == 0 else b.valid_data[data_idx - 1]
-        score = np.asarray(dd.score, np.float64)
-        for m in metrics:
-            for mname, v in zip(m.names, m.eval(score)):
-                out.append((name, mname, v, m.factor_to_bigger_better > 0))
+        with timetag.scope("GBDT::metric"):
+            score = np.asarray(dd.score, np.float64)
+            for m in metrics:
+                for mname, v in zip(m.names, m.eval(score)):
+                    out.append((name, mname, v,
+                                m.factor_to_bigger_better > 0))
         if feval is not None:
             ds = (self._train_set if data_idx == 0
                   else self._valid_sets[data_idx - 1])

@@ -1,0 +1,14 @@
+"""C API surface (reference include/LightGBM/c_api.h, src/c_api.cpp).
+
+Two ways to use it:
+
+- ``build_library()`` -> path to ``lib_lightgbm_tpu.so``, a real shared
+  library (cffi embedding) exporting every LGBM_* symbol for C/ctypes
+  callers — the drop-in equivalent of the reference's lib_lightgbm.so.
+- ``lightgbm_tpu.capi.impl`` -> the same functions callable in-process
+  (used by the test-suite and any Python host that wants the C semantics
+  without loading a library).
+"""
+
+from .build import build_library  # noqa: F401
+from .cdef import API_NAMES, CDEF  # noqa: F401

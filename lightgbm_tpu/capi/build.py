@@ -1,0 +1,87 @@
+"""Build lib_lightgbm_tpu.so — a C-loadable library exporting the LGBM_*
+API — via cffi's embedding mode (pybind11 is not available in this
+environment; cffi embedding compiles a real shared library that boots an
+embedded CPython on first call and dispatches to impl.py).
+
+The library is built once into a per-user cache directory keyed by the
+source hash (same policy as io/native.py) and can be loaded from any C
+program or ctypes, exactly like the reference's lib_lightgbm.so
+(tests/c_api_test/test.py flow).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import sys
+import tempfile
+
+from .cdef import CDEF
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_MODULE_NAME = "_lightgbm_tpu_capi"
+_LIB_BASENAME = "lib_lightgbm_tpu.so"
+
+_INIT_CODE = """
+from {module} import ffi
+
+
+def _boot():
+    import sys
+    for p in {extra_paths!r}:
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    from lightgbm_tpu.capi import impl
+    impl.bind(ffi)
+
+
+_boot()
+"""
+
+
+def _cache_dir() -> str:
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "lightgbm_tpu")
+
+
+def _source_hash() -> str:
+    h = hashlib.sha256()
+    here = os.path.dirname(os.path.abspath(__file__))
+    for name in ("cdef.py", "impl.py", "build.py"):
+        with open(os.path.join(here, name), "rb") as fh:
+            h.update(fh.read())
+    h.update(sys.version.encode())
+    return h.hexdigest()[:16]
+
+
+def build_library(force: bool = False) -> str:
+    """Return the path to lib_lightgbm_tpu.so, building it if needed."""
+    cache = _cache_dir()
+    os.makedirs(cache, exist_ok=True)
+    tag = _source_hash()
+    lib_path = os.path.join(cache, f"{_LIB_BASENAME}.{tag}")
+    if os.path.exists(lib_path) and not force:
+        return lib_path
+
+    import cffi
+    ffibuilder = cffi.FFI()
+    ffibuilder.embedding_api(CDEF)
+    ffibuilder.set_source(_MODULE_NAME, "")
+    ffibuilder.embedding_init_code(_INIT_CODE.format(
+        module=_MODULE_NAME, extra_paths=[_REPO_ROOT]))
+
+    with tempfile.TemporaryDirectory(prefix="lgbt_capi_") as tmp:
+        out = ffibuilder.compile(tmpdir=tmp, target=_LIB_BASENAME,
+                                 verbose=False)
+        tmp_dst = lib_path + f".tmp{os.getpid()}"
+        shutil.copy2(out, tmp_dst)
+        os.replace(tmp_dst, lib_path)  # atomic publish
+    return lib_path
+
+
+if __name__ == "__main__":
+    print(build_library(force="--force" in sys.argv))

@@ -1,0 +1,308 @@
+"""C declarations of the LGBM_* API surface.
+
+Mirrors /root/reference/include/LightGBM/c_api.h:37-717 exactly (minus the
+LIGHTGBM_C_EXPORT macro): same names, same argument types, same handle
+model, so a caller written against the reference's lib_lightgbm.so —
+including the reference's own python-package/basic.py ctypes bindings and
+tests/c_api_test/test.py — can load lib_lightgbm_tpu.so instead.
+"""
+
+CDEF = r"""
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+const char* LGBM_GetLastError();
+
+int LGBM_DatasetCreateFromFile(const char* filename,
+                               const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out);
+
+int LGBM_DatasetCreateFromSampledColumn(double** sample_data,
+                                        int** sample_indices,
+                                        int32_t ncol,
+                                        const int* num_per_col,
+                                        int32_t num_sample_row,
+                                        int32_t num_total_row,
+                                        const char* parameters,
+                                        DatasetHandle* out);
+
+int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                  int64_t num_total_row,
+                                  DatasetHandle* out);
+
+int LGBM_DatasetPushRows(DatasetHandle dataset,
+                         const void* data,
+                         int data_type,
+                         int32_t nrow,
+                         int32_t ncol,
+                         int32_t start_row);
+
+int LGBM_DatasetPushRowsByCSR(DatasetHandle dataset,
+                              const void* indptr,
+                              int indptr_type,
+                              const int32_t* indices,
+                              const void* data,
+                              int data_type,
+                              int64_t nindptr,
+                              int64_t nelem,
+                              int64_t num_col,
+                              int64_t start_row);
+
+int LGBM_DatasetCreateFromCSR(const void* indptr,
+                              int indptr_type,
+                              const int32_t* indices,
+                              const void* data,
+                              int data_type,
+                              int64_t nindptr,
+                              int64_t nelem,
+                              int64_t num_col,
+                              const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
+
+int LGBM_DatasetCreateFromCSC(const void* col_ptr,
+                              int col_ptr_type,
+                              const int32_t* indices,
+                              const void* data,
+                              int data_type,
+                              int64_t ncol_ptr,
+                              int64_t nelem,
+                              int64_t num_row,
+                              const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
+
+int LGBM_DatasetCreateFromMat(const void* data,
+                              int data_type,
+                              int32_t nrow,
+                              int32_t ncol,
+                              int is_row_major,
+                              const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
+
+int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices,
+                          const char* parameters,
+                          DatasetHandle* out);
+
+int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                const char** feature_names,
+                                int num_feature_names);
+
+int LGBM_DatasetGetFeatureNames(DatasetHandle handle,
+                                char** feature_names,
+                                int* num_feature_names);
+
+int LGBM_DatasetFree(DatasetHandle handle);
+
+int LGBM_DatasetSaveBinary(DatasetHandle handle,
+                           const char* filename);
+
+int LGBM_DatasetSetField(DatasetHandle handle,
+                         const char* field_name,
+                         const void* field_data,
+                         int num_element,
+                         int type);
+
+int LGBM_DatasetGetField(DatasetHandle handle,
+                         const char* field_name,
+                         int* out_len,
+                         const void** out_ptr,
+                         int* out_type);
+
+int LGBM_DatasetGetNumData(DatasetHandle handle, int* out);
+
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int* out);
+
+int LGBM_BoosterCreate(const DatasetHandle train_data,
+                       const char* parameters,
+                       BoosterHandle* out);
+
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out);
+
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out);
+
+int LGBM_BoosterFree(BoosterHandle handle);
+
+int LGBM_BoosterMerge(BoosterHandle handle,
+                      BoosterHandle other_handle);
+
+int LGBM_BoosterAddValidData(BoosterHandle handle,
+                             const DatasetHandle valid_data);
+
+int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                  const DatasetHandle train_data);
+
+int LGBM_BoosterResetParameter(BoosterHandle handle, const char* parameters);
+
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
+
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
+
+int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle,
+                                    const float* grad,
+                                    const float* hess,
+                                    int* is_finished);
+
+int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
+
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration);
+
+int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len);
+
+int LGBM_BoosterGetEvalNames(BoosterHandle handle, int* out_len, char** out_strs);
+
+int LGBM_BoosterGetFeatureNames(BoosterHandle handle, int* out_len, char** out_strs);
+
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len);
+
+int LGBM_BoosterGetEval(BoosterHandle handle,
+                        int data_idx,
+                        int* out_len,
+                        double* out_results);
+
+int LGBM_BoosterGetNumPredict(BoosterHandle handle,
+                              int data_idx,
+                              int64_t* out_len);
+
+int LGBM_BoosterGetPredict(BoosterHandle handle,
+                           int data_idx,
+                           int64_t* out_len,
+                           double* out_result);
+
+int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                               const char* data_filename,
+                               int data_has_header,
+                               int predict_type,
+                               int num_iteration,
+                               const char* result_filename);
+
+int LGBM_BoosterCalcNumPredict(BoosterHandle handle,
+                               int num_row,
+                               int predict_type,
+                               int num_iteration,
+                               int64_t* out_len);
+
+int LGBM_BoosterPredictForCSR(BoosterHandle handle,
+                              const void* indptr,
+                              int indptr_type,
+                              const int32_t* indices,
+                              const void* data,
+                              int data_type,
+                              int64_t nindptr,
+                              int64_t nelem,
+                              int64_t num_col,
+                              int predict_type,
+                              int num_iteration,
+                              int64_t* out_len,
+                              double* out_result);
+
+int LGBM_BoosterPredictForCSC(BoosterHandle handle,
+                              const void* col_ptr,
+                              int col_ptr_type,
+                              const int32_t* indices,
+                              const void* data,
+                              int data_type,
+                              int64_t ncol_ptr,
+                              int64_t nelem,
+                              int64_t num_row,
+                              int predict_type,
+                              int num_iteration,
+                              int64_t* out_len,
+                              double* out_result);
+
+int LGBM_BoosterPredictForMat(BoosterHandle handle,
+                              const void* data,
+                              int data_type,
+                              int32_t nrow,
+                              int32_t ncol,
+                              int is_row_major,
+                              int predict_type,
+                              int num_iteration,
+                              int64_t* out_len,
+                              double* out_result);
+
+int LGBM_BoosterSaveModel(BoosterHandle handle,
+                          int num_iteration,
+                          const char* filename);
+
+int LGBM_BoosterSaveModelToString(BoosterHandle handle,
+                                  int num_iteration,
+                                  int buffer_len,
+                                  int* out_len,
+                                  char* out_str);
+
+int LGBM_BoosterDumpModel(BoosterHandle handle,
+                          int num_iteration,
+                          int buffer_len,
+                          int* out_len,
+                          char* out_str);
+
+int LGBM_BoosterGetLeafValue(BoosterHandle handle,
+                             int tree_idx,
+                             int leaf_idx,
+                             double* out_val);
+
+int LGBM_BoosterSetLeafValue(BoosterHandle handle,
+                             int tree_idx,
+                             int leaf_idx,
+                             double val);
+"""
+
+API_NAMES = [
+    "LGBM_GetLastError",
+    "LGBM_DatasetCreateFromFile",
+    "LGBM_DatasetCreateFromSampledColumn",
+    "LGBM_DatasetCreateByReference",
+    "LGBM_DatasetPushRows",
+    "LGBM_DatasetPushRowsByCSR",
+    "LGBM_DatasetCreateFromCSR",
+    "LGBM_DatasetCreateFromCSC",
+    "LGBM_DatasetCreateFromMat",
+    "LGBM_DatasetGetSubset",
+    "LGBM_DatasetSetFeatureNames",
+    "LGBM_DatasetGetFeatureNames",
+    "LGBM_DatasetFree",
+    "LGBM_DatasetSaveBinary",
+    "LGBM_DatasetSetField",
+    "LGBM_DatasetGetField",
+    "LGBM_DatasetGetNumData",
+    "LGBM_DatasetGetNumFeature",
+    "LGBM_BoosterCreate",
+    "LGBM_BoosterCreateFromModelfile",
+    "LGBM_BoosterLoadModelFromString",
+    "LGBM_BoosterFree",
+    "LGBM_BoosterMerge",
+    "LGBM_BoosterAddValidData",
+    "LGBM_BoosterResetTrainingData",
+    "LGBM_BoosterResetParameter",
+    "LGBM_BoosterGetNumClasses",
+    "LGBM_BoosterUpdateOneIter",
+    "LGBM_BoosterUpdateOneIterCustom",
+    "LGBM_BoosterRollbackOneIter",
+    "LGBM_BoosterGetCurrentIteration",
+    "LGBM_BoosterGetEvalCounts",
+    "LGBM_BoosterGetEvalNames",
+    "LGBM_BoosterGetFeatureNames",
+    "LGBM_BoosterGetNumFeature",
+    "LGBM_BoosterGetEval",
+    "LGBM_BoosterGetNumPredict",
+    "LGBM_BoosterGetPredict",
+    "LGBM_BoosterPredictForFile",
+    "LGBM_BoosterCalcNumPredict",
+    "LGBM_BoosterPredictForCSR",
+    "LGBM_BoosterPredictForCSC",
+    "LGBM_BoosterPredictForMat",
+    "LGBM_BoosterSaveModel",
+    "LGBM_BoosterSaveModelToString",
+    "LGBM_BoosterDumpModel",
+    "LGBM_BoosterGetLeafValue",
+    "LGBM_BoosterSetLeafValue",
+]

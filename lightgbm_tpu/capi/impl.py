@@ -1,0 +1,793 @@
+"""Pure-Python implementation of the reference C API.
+
+Each function here implements one LGBM_* entry point from
+/root/reference/include/LightGBM/c_api.h (see cdef.py), with the semantics
+of /root/reference/src/c_api.cpp:28-900 — handle registry, thread-local
+last-error, -1/0 return convention, GetPredictAt's sigmoid/softmax
+transform, SaveModelToString's buffer_len/out_len re-allocation protocol —
+but backed by the JAX engine (models/, io/) instead of the C++ core.
+
+The functions receive cffi cdata arguments; ``bind(ffi)`` registers them as
+the extern definitions of the embedded library built by build.py.  They can
+also be exercised in-process with a plain ``cffi.FFI()`` for tests.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..config import Config
+from ..io.binning import CATEGORICAL, NUMERICAL, BinMapper
+from ..io.dataset import BinnedDataset, Metadata
+from ..io.parser import parse_file
+from ..models import create_boosting
+from ..models.gbdt import GBDT
+
+C_API_DTYPE_FLOAT32 = 0
+C_API_DTYPE_FLOAT64 = 1
+C_API_DTYPE_INT32 = 2
+C_API_DTYPE_INT64 = 3
+
+C_API_PREDICT_NORMAL = 0
+C_API_PREDICT_RAW_SCORE = 1
+C_API_PREDICT_LEAF_INDEX = 2
+
+_NP_DTYPE = {C_API_DTYPE_FLOAT32: np.float32, C_API_DTYPE_FLOAT64: np.float64,
+             C_API_DTYPE_INT32: np.int32, C_API_DTYPE_INT64: np.int64}
+
+ffi = None  # set by bind()
+
+_handles: Dict[int, object] = {}
+_next_id = [1]
+_lock = threading.Lock()
+_tls = threading.local()
+
+
+class _CApiError(Exception):
+    pass
+
+
+def _set_last_error(msg: str) -> None:
+    _tls.err = msg.encode("utf-8", "replace")[:511]
+
+
+def _register(obj) -> int:
+    with _lock:
+        hid = _next_id[0]
+        _next_id[0] += 1
+        _handles[hid] = obj
+    return hid
+
+
+def _from_handle(handle):
+    hid = int(ffi.cast("uintptr_t", handle))
+    try:
+        return _handles[hid]
+    except KeyError:
+        raise _CApiError(f"Invalid handle {hid}")
+
+
+def _free_handle(handle) -> None:
+    hid = int(ffi.cast("uintptr_t", handle))
+    _handles.pop(hid, None)
+
+
+def _str(char_p, default="") -> str:
+    if char_p == ffi.NULL:
+        return default
+    return ffi.string(char_p).decode("utf-8")
+
+
+def _np_from_ptr(ptr, dtype_code: int, count: int) -> np.ndarray:
+    dt = np.dtype(_NP_DTYPE[int(dtype_code)])
+    buf = ffi.buffer(ffi.cast("char *", ptr), count * dt.itemsize)
+    return np.frombuffer(buf, dtype=dt).copy()
+
+
+def _parse_params(parameters) -> Dict[str, str]:
+    """key1=value1 key2=value2 (ConfigBase::Str2Map, config.cpp:15-28)."""
+    out: Dict[str, str] = {}
+    for tok in _str(parameters).replace("\n", " ").split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# wrapper objects
+# ---------------------------------------------------------------------------
+
+class _CDataset:
+    """DatasetHandle payload: a BinnedDataset, or a push-mode dataset being
+    filled row-by-row (c_api.cpp push flows)."""
+
+    def __init__(self, binned: Optional[BinnedDataset], params: Dict[str, str]):
+        self.binned = binned
+        self.params = params
+        self.field_cache: Dict[str, np.ndarray] = {}
+        self.num_pushed = 0
+        self.num_total_row = binned.num_data if binned is not None else 0
+
+    # -- push-mode construction -----------------------------------------
+    @classmethod
+    def from_mappers(cls, mappers_per_real: List[Optional[BinMapper]],
+                     num_total_row: int, max_bin: int,
+                     params: Dict[str, str]) -> "_CDataset":
+        """Empty dataset with pre-agreed mappers, to be filled by PushRows
+        (Dataset::CreateValid-like allocation, c_api.cpp:341-415)."""
+        ds = BinnedDataset()
+        ds.num_total_features = len(mappers_per_real)
+        ds.max_bin = max_bin
+        ds.feature_names = [f"Column_{i}"
+                            for i in range(ds.num_total_features)]
+        ds.real_to_inner = np.full(ds.num_total_features, -1, dtype=np.int64)
+        used, mappers = [], []
+        for f, m in enumerate(mappers_per_real):
+            if m is None or m.is_trivial:
+                continue
+            ds.real_to_inner[f] = len(used)
+            used.append(f)
+            mappers.append(m)
+        ds.used_feature_map = used
+        ds.mappers = mappers
+        dtype = np.uint8 if max([m.num_bin for m in mappers] or [1]) <= 256 \
+            else np.uint16
+        ds.bins = np.zeros((len(used), num_total_row), dtype=dtype)
+        ds.metadata = Metadata(num_total_row)
+        ds.metadata.set_label(np.zeros(num_total_row, dtype=np.float32))
+        self = cls(ds, params)
+        self.num_total_row = num_total_row
+        return self
+
+    def push_rows(self, rows: np.ndarray, start_row: int) -> None:
+        ds = self.binned
+        for inner, f in enumerate(ds.used_feature_map):
+            ds.bins[inner, start_row:start_row + rows.shape[0]] = \
+                ds.mappers[inner].value_to_bin(rows[:, f]).astype(ds.bins.dtype)
+        self.num_pushed += rows.shape[0]
+        # nrow + start_row == num_total_row triggers FinishLoad in the
+        # reference; binning is already done per push here, so nothing more.
+
+
+class _CBooster:
+    """BoosterHandle payload (c_api.cpp Booster, :28-252)."""
+
+    def __init__(self, booster: GBDT, config: Config):
+        self.b = booster
+        self.config = config
+        self.valid_handles: List[_CDataset] = []
+
+    # eval name list shared by all datasets (Booster::GetEvalNames)
+    def eval_names(self) -> List[str]:
+        names: List[str] = []
+        for m in getattr(self.b, "train_metrics", []):
+            names.extend(m.names)
+        return names
+
+    def eval_at(self, data_idx: int) -> List[float]:
+        b = self.b
+        if data_idx == 0:
+            score = np.asarray(b.train_data.score, np.float64)
+            metrics = b.train_metrics
+        else:
+            dd = b.valid_data[data_idx - 1]
+            score = np.asarray(dd.score, np.float64)
+            metrics = b.valid_metrics[data_idx - 1]
+        out: List[float] = []
+        for m in metrics:
+            out.extend(float(v) for v in m.eval(score))
+        return out
+
+    def predict_at(self, data_idx: int) -> np.ndarray:
+        """GetPredictAt (gbdt.cpp:817-851): raw scores with the softmax /
+        sigmoid output transform applied, class-major [num_class * n]."""
+        b = self.b
+        dd = b.train_data if data_idx == 0 else b.valid_data[data_idx - 1]
+        raw = np.asarray(dd.score, np.float64)
+        return np.asarray(b.objective.convert_output(raw)).reshape(-1)
+
+    def n_pred_per_row(self, predict_type: int, num_iteration: int) -> int:
+        b = self.b
+        if predict_type == C_API_PREDICT_LEAF_INDEX:
+            n_models = len(b.models)
+            if num_iteration > 0:
+                n_models = min(n_models, num_iteration * b.num_class)
+            return n_models
+        return b.num_class
+
+    def predict_mat(self, X: np.ndarray, predict_type: int,
+                    num_iteration: int) -> np.ndarray:
+        """Row-major [n, n_pred_per_row] like Predictor's per-row writer
+        (predictor.hpp:81-129)."""
+        b = self.b
+        if predict_type == C_API_PREDICT_LEAF_INDEX:
+            return np.asarray(b.predict_leaf_index(X, num_iteration),
+                              np.float64)
+        raw = np.asarray(b.predict_raw(X, num_iteration), np.float64)
+        if predict_type == C_API_PREDICT_NORMAL and \
+                getattr(b, "objective", None) is not None:
+            raw = np.asarray(b.objective.convert_output(raw), np.float64)
+        return raw.T  # [n, num_class]
+
+
+# ---------------------------------------------------------------------------
+# dataset construction helpers
+# ---------------------------------------------------------------------------
+
+def _dataset_params(params: Dict[str, str]):
+    cfg = Config({**params, "task": "train"})
+    return cfg
+
+
+def _binned_from_matrix(X: np.ndarray, params: Dict[str, str],
+                        reference: Optional[BinnedDataset]) -> BinnedDataset:
+    if reference is not None:
+        return reference.create_valid(X, None)
+    cfg = _dataset_params(params)
+    return BinnedDataset.from_matrix(
+        X, None, max_bin=cfg.max_bin, min_data_in_bin=cfg.min_data_in_bin,
+        min_data_in_leaf=cfg.min_data_in_leaf,
+        bin_construct_sample_cnt=cfg.bin_construct_sample_cnt,
+        categorical_features=[], data_random_seed=cfg.data_random_seed)
+
+
+def _csr_to_dense(indptr, indptr_type, indices, data, data_type,
+                  nindptr, nelem, num_col) -> np.ndarray:
+    ip = _np_from_ptr(indptr, indptr_type, int(nindptr)).astype(np.int64)
+    idx = _np_from_ptr(indices, C_API_DTYPE_INT32, int(nelem))
+    val = _np_from_ptr(data, data_type, int(nelem)).astype(np.float64)
+    nrow = int(nindptr) - 1
+    ncol = int(num_col)
+    if ncol <= 0:
+        ncol = int(idx.max()) + 1 if nelem else 0
+    X = np.zeros((nrow, ncol), dtype=np.float64)
+    for r in range(nrow):
+        a, b = ip[r], ip[r + 1]
+        X[r, idx[a:b]] = val[a:b]
+    return X
+
+
+def _csc_to_dense(col_ptr, col_ptr_type, indices, data, data_type,
+                  ncol_ptr, nelem, num_row) -> np.ndarray:
+    cp = _np_from_ptr(col_ptr, col_ptr_type, int(ncol_ptr)).astype(np.int64)
+    idx = _np_from_ptr(indices, C_API_DTYPE_INT32, int(nelem))
+    val = _np_from_ptr(data, data_type, int(nelem)).astype(np.float64)
+    ncol = int(ncol_ptr) - 1
+    X = np.zeros((int(num_row), ncol), dtype=np.float64)
+    for c in range(ncol):
+        a, b = cp[c], cp[c + 1]
+        X[idx[a:b], c] = val[a:b]
+    return X
+
+
+def _mat_to_dense(data, data_type, nrow, ncol, is_row_major) -> np.ndarray:
+    flat = _np_from_ptr(data, data_type, int(nrow) * int(ncol))
+    if int(is_row_major):
+        return flat.reshape(int(nrow), int(ncol)).astype(np.float64)
+    return flat.reshape(int(ncol), int(nrow)).T.astype(np.float64)
+
+
+# ---------------------------------------------------------------------------
+# the C API functions
+# ---------------------------------------------------------------------------
+# Every function below is registered under its own name via bind(); the
+# @_capi decorator adds the 0/-1 + LastError convention.
+
+def _capi(fn):
+    def wrapper(*args):
+        try:
+            fn(*args)
+            return 0
+        except Exception as exc:  # noqa: BLE001 - C boundary
+            _set_last_error(f"{type(exc).__name__}: {exc}")
+            return -1
+    wrapper.__name__ = fn.__name__
+    wrapper._raw = fn
+    return wrapper
+
+
+def LGBM_GetLastError():
+    buf = getattr(_tls, "err_buf", None)
+    if buf is None:
+        buf = _tls.err_buf = ffi.new("char[512]")
+    msg = getattr(_tls, "err", b"Everything is fine")
+    buf[0:len(msg)] = msg
+    buf[len(msg)] = b"\x00"
+    return buf
+
+
+@_capi
+def LGBM_DatasetCreateFromFile(filename, parameters, reference, out):
+    params = _parse_params(parameters)
+    path = _str(filename)
+    ref = _from_handle(reference).binned if reference != ffi.NULL else None
+    if BinnedDataset.is_binary_file(path):
+        binned = BinnedDataset.load_binary(path)
+    else:
+        label, X, header = parse_file(
+            path, has_header=params.get("has_header", "").lower()
+            in ("true", "1"),
+            label_idx=int(params.get("label_column", 0)))
+        binned = _binned_from_matrix(X, params, ref)
+        if label is not None:
+            binned.metadata.set_label(label)
+        if header:
+            binned.feature_names = list(header)
+        binned.metadata.load_side_files(path)
+    ds = _CDataset(binned, params)
+    out[0] = ffi.cast("void *", _register(ds))
+
+
+@_capi
+def LGBM_DatasetCreateFromSampledColumn(sample_data, sample_indices, ncol,
+                                        num_per_col, num_sample_row,
+                                        num_total_row, parameters, out):
+    """Construct mappers from per-column samples, then await PushRows
+    (DatasetLoader::CostructFromSampleData, dataset_loader.cpp:657-722)."""
+    params = _parse_params(parameters)
+    cfg = _dataset_params(params)
+    n_total = int(num_total_row)
+    n_sample = int(num_sample_row)
+    filter_cnt = int(0.95 * cfg.min_data_in_leaf / max(1, n_total) * n_sample)
+    mappers: List[Optional[BinMapper]] = []
+    for c in range(int(ncol)):
+        cnt = int(num_per_col[c])
+        col = np.frombuffer(ffi.buffer(sample_data[c], cnt * 8),
+                            dtype=np.float64)
+        nonzero = col[col != 0.0]
+        m = BinMapper().find_bin(nonzero, n_sample, cfg.max_bin,
+                                 cfg.min_data_in_bin, filter_cnt, NUMERICAL)
+        mappers.append(None if m.is_trivial else m)
+    ds = _CDataset.from_mappers(mappers, n_total, cfg.max_bin, params)
+    out[0] = ffi.cast("void *", _register(ds))
+
+
+@_capi
+def LGBM_DatasetCreateByReference(reference, num_total_row, out):
+    ref = _from_handle(reference)
+    rb = ref.binned
+    mappers: List[Optional[BinMapper]] = [None] * rb.num_total_features
+    for inner, f in enumerate(rb.used_feature_map):
+        mappers[f] = rb.mappers[inner]
+    ds = _CDataset.from_mappers(mappers, int(num_total_row), rb.max_bin,
+                                dict(ref.params))
+    ds.binned.feature_names = list(rb.feature_names)
+    out[0] = ffi.cast("void *", _register(ds))
+
+
+@_capi
+def LGBM_DatasetPushRows(dataset, data, data_type, nrow, ncol, start_row):
+    ds = _from_handle(dataset)
+    rows = _mat_to_dense(data, data_type, nrow, ncol, 1)
+    ds.push_rows(rows, int(start_row))
+
+
+@_capi
+def LGBM_DatasetPushRowsByCSR(dataset, indptr, indptr_type, indices, data,
+                              data_type, nindptr, nelem, num_col, start_row):
+    ds = _from_handle(dataset)
+    rows = _csr_to_dense(indptr, indptr_type, indices, data, data_type,
+                         nindptr, nelem, num_col)
+    ds.push_rows(rows, int(start_row))
+
+
+@_capi
+def LGBM_DatasetCreateFromCSR(indptr, indptr_type, indices, data, data_type,
+                              nindptr, nelem, num_col, parameters, reference,
+                              out):
+    params = _parse_params(parameters)
+    ref = _from_handle(reference).binned if reference != ffi.NULL else None
+    X = _csr_to_dense(indptr, indptr_type, indices, data, data_type,
+                      nindptr, nelem, num_col)
+    ds = _CDataset(_binned_from_matrix(X, params, ref), params)
+    out[0] = ffi.cast("void *", _register(ds))
+
+
+@_capi
+def LGBM_DatasetCreateFromCSC(col_ptr, col_ptr_type, indices, data, data_type,
+                              ncol_ptr, nelem, num_row, parameters, reference,
+                              out):
+    params = _parse_params(parameters)
+    ref = _from_handle(reference).binned if reference != ffi.NULL else None
+    X = _csc_to_dense(col_ptr, col_ptr_type, indices, data, data_type,
+                      ncol_ptr, nelem, num_row)
+    ds = _CDataset(_binned_from_matrix(X, params, ref), params)
+    out[0] = ffi.cast("void *", _register(ds))
+
+
+@_capi
+def LGBM_DatasetCreateFromMat(data, data_type, nrow, ncol, is_row_major,
+                              parameters, reference, out):
+    params = _parse_params(parameters)
+    ref = _from_handle(reference).binned if reference != ffi.NULL else None
+    X = _mat_to_dense(data, data_type, nrow, ncol, is_row_major)
+    ds = _CDataset(_binned_from_matrix(X, params, ref), params)
+    out[0] = ffi.cast("void *", _register(ds))
+
+
+@_capi
+def LGBM_DatasetGetSubset(handle, used_row_indices, num_used_row_indices,
+                          parameters, out):
+    ds = _from_handle(handle)
+    idx = _np_from_ptr(used_row_indices, C_API_DTYPE_INT32,
+                       int(num_used_row_indices))
+    sub = _CDataset(ds.binned.subset(idx), _parse_params(parameters))
+    out[0] = ffi.cast("void *", _register(sub))
+
+
+@_capi
+def LGBM_DatasetSetFeatureNames(handle, feature_names, num_feature_names):
+    ds = _from_handle(handle)
+    ds.binned.feature_names = [
+        ffi.string(feature_names[i]).decode("utf-8")
+        for i in range(int(num_feature_names))]
+
+
+@_capi
+def LGBM_DatasetGetFeatureNames(handle, feature_names, num_feature_names):
+    ds = _from_handle(handle)
+    names = ds.binned.feature_names
+    for i, name in enumerate(names):
+        raw = name.encode("utf-8")[:254] + b"\x00"
+        ffi.memmove(feature_names[i], raw, len(raw))
+    num_feature_names[0] = len(names)
+
+
+@_capi
+def LGBM_DatasetFree(handle):
+    _free_handle(handle)
+
+
+@_capi
+def LGBM_DatasetSaveBinary(handle, filename):
+    _from_handle(handle).binned.save_binary(_str(filename))
+
+
+@_capi
+def LGBM_DatasetSetField(handle, field_name, field_data, num_element, type_):
+    ds = _from_handle(handle)
+    name = _str(field_name)
+    n = int(num_element)
+    md = ds.binned.metadata
+    if name == "label":
+        md.set_label(_np_from_ptr(field_data, type_, n))
+    elif name == "weight":
+        md.set_weights(_np_from_ptr(field_data, type_, n))
+    elif name in ("init_score",):
+        md.set_init_score(_np_from_ptr(field_data, type_, n))
+    elif name in ("group", "query"):
+        md.set_query(_np_from_ptr(field_data, type_, n))
+    elif name in ("group_id", "query_id"):
+        md.set_query_id(_np_from_ptr(field_data, type_, n))
+    else:
+        raise _CApiError(f"Unknown field name {name!r}")
+
+
+@_capi
+def LGBM_DatasetGetField(handle, field_name, out_len, out_ptr, out_type):
+    ds = _from_handle(handle)
+    name = _str(field_name)
+    md = ds.binned.metadata
+    if name == "label":
+        arr, t = np.ascontiguousarray(md.label, np.float32), \
+            C_API_DTYPE_FLOAT32
+    elif name == "weight":
+        if md.weights is None:
+            raise _CApiError("weight field is empty")
+        arr, t = np.ascontiguousarray(md.weights, np.float32), \
+            C_API_DTYPE_FLOAT32
+    elif name == "init_score":
+        if md.init_score is None:
+            raise _CApiError("init_score field is empty")
+        arr, t = np.ascontiguousarray(md.init_score, np.float64), \
+            C_API_DTYPE_FLOAT64
+    elif name in ("group", "query"):
+        if md.query_boundaries is None:
+            raise _CApiError("group field is empty")
+        # the reference returns the NUM_QUERY+1 cumulative boundaries
+        arr, t = np.ascontiguousarray(md.query_boundaries, np.int32), \
+            C_API_DTYPE_INT32
+    else:
+        raise _CApiError(f"Unknown field name {name!r}")
+    ds.field_cache[name] = arr
+    out_len[0] = arr.shape[0]
+    out_ptr[0] = ffi.cast("const void *", arr.ctypes.data)
+    out_type[0] = t
+
+
+@_capi
+def LGBM_DatasetGetNumData(handle, out):
+    out[0] = int(_from_handle(handle).binned.num_data)
+
+
+@_capi
+def LGBM_DatasetGetNumFeature(handle, out):
+    out[0] = int(_from_handle(handle).binned.num_total_features)
+
+
+# --- Booster ---------------------------------------------------------------
+
+@_capi
+def LGBM_BoosterCreate(train_data, parameters, out):
+    ds = _from_handle(train_data)
+    cfg = Config(_parse_params(parameters))
+    booster = create_boosting(cfg, ds.binned)
+    out[0] = ffi.cast("void *", _register(_CBooster(booster, cfg)))
+
+
+@_capi
+def LGBM_BoosterCreateFromModelfile(filename, out_num_iterations, out):
+    with open(_str(filename)) as fh:
+        model_str = fh.read()
+    cfg = Config({})
+    booster = create_boosting(cfg, None, model_str=model_str)
+    out_num_iterations[0] = booster.num_init_iteration
+    out[0] = ffi.cast("void *", _register(_CBooster(booster, cfg)))
+
+
+@_capi
+def LGBM_BoosterLoadModelFromString(model_str, out_num_iterations, out):
+    cfg = Config({})
+    booster = create_boosting(cfg, None, model_str=_str(model_str))
+    out_num_iterations[0] = booster.num_init_iteration
+    out[0] = ffi.cast("void *", _register(_CBooster(booster, cfg)))
+
+
+@_capi
+def LGBM_BoosterFree(handle):
+    _free_handle(handle)
+
+
+@_capi
+def LGBM_BoosterMerge(handle, other_handle):
+    """Append other's trees (GBDT::MergeFrom, gbdt.cpp:90-99: models are
+    merged; score updaters are deliberately left untouched)."""
+    cb = _from_handle(handle)
+    other = _from_handle(other_handle)
+    cb.b.models.extend(other.b.models)
+    cb.b.iter_ = len(cb.b.models) // max(cb.b.num_class, 1)
+
+
+@_capi
+def LGBM_BoosterAddValidData(handle, valid_data):
+    cb = _from_handle(handle)
+    ds = _from_handle(valid_data)
+    cb.b.add_valid_dataset(ds.binned)
+    cb.valid_handles.append(ds)
+
+
+@_capi
+def LGBM_BoosterResetTrainingData(handle, train_data):
+    cb = _from_handle(handle)
+    cb.b.reset_training_data(_from_handle(train_data).binned)
+
+
+@_capi
+def LGBM_BoosterResetParameter(handle, parameters):
+    cb = _from_handle(handle)
+    params = _parse_params(parameters)
+    for banned in ("num_class", "boosting_type", "boosting", "metric"):
+        if banned in params:
+            raise _CApiError(f"cannot change {banned} during training")
+    merged = Config({**cb.config.raw_params, **params})
+    cb.config = merged
+    cb.b.reset_config(merged)
+
+
+@_capi
+def LGBM_BoosterGetNumClasses(handle, out_len):
+    out_len[0] = int(_from_handle(handle).b.num_class)
+
+
+@_capi
+def LGBM_BoosterUpdateOneIter(handle, is_finished):
+    stop = _from_handle(handle).b.train_one_iter()
+    is_finished[0] = 1 if stop else 0
+
+
+@_capi
+def LGBM_BoosterUpdateOneIterCustom(handle, grad, hess, is_finished):
+    cb = _from_handle(handle)
+    n = cb.b.num_data * cb.b.num_class
+    g = _np_from_ptr(grad, C_API_DTYPE_FLOAT32, n)
+    h = _np_from_ptr(hess, C_API_DTYPE_FLOAT32, n)
+    stop = cb.b.train_one_iter(g, h)
+    is_finished[0] = 1 if stop else 0
+
+
+@_capi
+def LGBM_BoosterRollbackOneIter(handle):
+    _from_handle(handle).b.rollback_one_iter()
+
+
+@_capi
+def LGBM_BoosterGetCurrentIteration(handle, out_iteration):
+    out_iteration[0] = int(_from_handle(handle).b.iter_)
+
+
+@_capi
+def LGBM_BoosterGetEvalCounts(handle, out_len):
+    out_len[0] = len(_from_handle(handle).eval_names())
+
+
+@_capi
+def LGBM_BoosterGetEvalNames(handle, out_len, out_strs):
+    names = _from_handle(handle).eval_names()
+    for i, name in enumerate(names):
+        raw = name.encode("utf-8")[:254] + b"\x00"
+        ffi.memmove(out_strs[i], raw, len(raw))
+    out_len[0] = len(names)
+
+
+@_capi
+def LGBM_BoosterGetFeatureNames(handle, out_len, out_strs):
+    names = _from_handle(handle).b.feature_names
+    for i, name in enumerate(names):
+        raw = name.encode("utf-8")[:254] + b"\x00"
+        ffi.memmove(out_strs[i], raw, len(raw))
+    out_len[0] = len(names)
+
+
+@_capi
+def LGBM_BoosterGetNumFeature(handle, out_len):
+    out_len[0] = int(_from_handle(handle).b.max_feature_idx + 1)
+
+
+@_capi
+def LGBM_BoosterGetEval(handle, data_idx, out_len, out_results):
+    vals = _from_handle(handle).eval_at(int(data_idx))
+    for i, v in enumerate(vals):
+        out_results[i] = v
+    out_len[0] = len(vals)
+
+
+@_capi
+def LGBM_BoosterGetNumPredict(handle, data_idx, out_len):
+    cb = _from_handle(handle)
+    b = cb.b
+    dd = b.train_data if int(data_idx) == 0 else b.valid_data[int(data_idx) - 1]
+    out_len[0] = int(dd.num_data * b.num_class)
+
+
+@_capi
+def LGBM_BoosterGetPredict(handle, data_idx, out_len, out_result):
+    pred = _from_handle(handle).predict_at(int(data_idx))
+    ffi.memmove(out_result, np.ascontiguousarray(pred, np.float64),
+                pred.size * 8)
+    out_len[0] = int(pred.size)
+
+
+@_capi
+def LGBM_BoosterPredictForFile(handle, data_filename, data_has_header,
+                               predict_type, num_iteration, result_filename):
+    cb = _from_handle(handle)
+    _, X, _ = parse_file(_str(data_filename),
+                         has_header=bool(int(data_has_header)),
+                         label_idx=cb.b.label_idx)
+    out = cb.predict_mat(X, int(predict_type), int(num_iteration))
+    with open(_str(result_filename), "w") as fh:
+        if out.ndim == 1 or out.shape[1] == 1:
+            for v in np.asarray(out).reshape(-1):
+                fh.write(f"{v:g}\n")
+        else:
+            for row in out:
+                fh.write("\t".join(f"{v:g}" for v in row) + "\n")
+
+
+@_capi
+def LGBM_BoosterCalcNumPredict(handle, num_row, predict_type, num_iteration,
+                               out_len):
+    cb = _from_handle(handle)
+    out_len[0] = int(num_row) * cb.n_pred_per_row(int(predict_type),
+                                                  int(num_iteration))
+
+
+def _write_pred(out_len, out_result, out: np.ndarray) -> None:
+    flat = np.ascontiguousarray(out, np.float64).reshape(-1)
+    ffi.memmove(out_result, flat, flat.size * 8)
+    out_len[0] = int(flat.size)
+
+
+@_capi
+def LGBM_BoosterPredictForCSR(handle, indptr, indptr_type, indices, data,
+                              data_type, nindptr, nelem, num_col,
+                              predict_type, num_iteration, out_len,
+                              out_result):
+    cb = _from_handle(handle)
+    ncol = int(num_col) if int(num_col) > 0 else cb.b.max_feature_idx + 1
+    X = _csr_to_dense(indptr, indptr_type, indices, data, data_type,
+                      nindptr, nelem, ncol)
+    _write_pred(out_len, out_result,
+                cb.predict_mat(X, int(predict_type), int(num_iteration)))
+
+
+@_capi
+def LGBM_BoosterPredictForCSC(handle, col_ptr, col_ptr_type, indices, data,
+                              data_type, ncol_ptr, nelem, num_row,
+                              predict_type, num_iteration, out_len,
+                              out_result):
+    cb = _from_handle(handle)
+    X = _csc_to_dense(col_ptr, col_ptr_type, indices, data, data_type,
+                      ncol_ptr, nelem, num_row)
+    _write_pred(out_len, out_result,
+                cb.predict_mat(X, int(predict_type), int(num_iteration)))
+
+
+@_capi
+def LGBM_BoosterPredictForMat(handle, data, data_type, nrow, ncol,
+                              is_row_major, predict_type, num_iteration,
+                              out_len, out_result):
+    cb = _from_handle(handle)
+    X = _mat_to_dense(data, data_type, nrow, ncol, is_row_major)
+    _write_pred(out_len, out_result,
+                cb.predict_mat(X, int(predict_type), int(num_iteration)))
+
+
+@_capi
+def LGBM_BoosterSaveModel(handle, num_iteration, filename):
+    _from_handle(handle).b.save_model_to_file(_str(filename),
+                                              int(num_iteration))
+
+
+def _string_out(text: str, buffer_len, out_len, out_str) -> None:
+    """The buffer_len/out_len re-allocation protocol (c_api.cpp:893-918):
+    out_len = needed size incl. NUL; copy only when the buffer fits."""
+    raw = text.encode("utf-8") + b"\x00"
+    out_len[0] = len(raw)
+    if int(buffer_len) >= len(raw):
+        ffi.memmove(out_str, raw, len(raw))
+
+
+@_capi
+def LGBM_BoosterSaveModelToString(handle, num_iteration, buffer_len, out_len,
+                                  out_str):
+    text = _from_handle(handle).b.save_model_to_string(int(num_iteration))
+    _string_out(text, buffer_len, out_len, out_str)
+
+
+@_capi
+def LGBM_BoosterDumpModel(handle, num_iteration, buffer_len, out_len,
+                          out_str):
+    b = _from_handle(handle).b
+    n_models = len(b.models)
+    if int(num_iteration) > 0:
+        n_models = min(n_models, int(num_iteration) * b.num_class)
+    dump = {
+        "name": "tree",
+        "num_class": b.num_class,
+        "label_index": b.label_idx,
+        "max_feature_idx": b.max_feature_idx,
+        "feature_names": list(b.feature_names),
+        "tree_info": [b.models[i].to_json() for i in range(n_models)],
+    }
+    _string_out(json.dumps(dump), buffer_len, out_len, out_str)
+
+
+@_capi
+def LGBM_BoosterGetLeafValue(handle, tree_idx, leaf_idx, out_val):
+    tree = _from_handle(handle).b.models[int(tree_idx)]
+    out_val[0] = float(tree.leaf_value[int(leaf_idx)])
+
+
+@_capi
+def LGBM_BoosterSetLeafValue(handle, tree_idx, leaf_idx, val):
+    tree = _from_handle(handle).b.models[int(tree_idx)]
+    tree.leaf_value[int(leaf_idx)] = float(val)
+
+
+# ---------------------------------------------------------------------------
+
+def bind(ffi_obj, register_externs: bool = True):
+    """Install the ffi and (for the embedded library) register every
+    LGBM_* function as its extern definition."""
+    global ffi
+    ffi = ffi_obj
+    if register_externs:
+        from .cdef import API_NAMES
+        for name in API_NAMES:
+            ffi_obj.def_extern(name=name)(globals()[name])

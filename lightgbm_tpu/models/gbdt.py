@@ -23,7 +23,7 @@ from ..objective import ObjectiveFunction, create_objective
 from ..ops.grow import (GrowParams, grow_tree, pack_tree_arrays,
                         unpack_tree_arrays)
 from ..ops.predict import predict_binned_forest, predict_binned_tree
-from ..utils import log
+from ..utils import log, timetag
 from .tree import Tree
 
 
@@ -194,6 +194,30 @@ class GBDT:
         for vi, dd in enumerate(self.valid_data):
             self.valid_metrics[vi] = self._make_metrics(config, dd.dataset)
 
+    def reset_training_data(self, train_set: BinnedDataset) -> None:
+        """GBDT::ResetTrainingData (gbdt.cpp:101-167 via c_api.cpp:70-97):
+        swap the training dataset (mapper-aligned), re-init objective and
+        training metrics against it, and replay the existing models into a
+        fresh score buffer."""
+        self._flush_pending()
+        cfg = self.config
+        self.train_set = train_set
+        self.num_data = train_set.num_data
+        self.objective.init(train_set.metadata, train_set.num_data)
+        self.num_bin = jnp.asarray(train_set.num_bin_per_feature())
+        self.is_cat = jnp.asarray(train_set.is_categorical_per_feature())
+        self.train_data = _DeviceData(train_set, self.num_class,
+                                      with_row_major=True)
+        self.train_metrics = self._make_metrics(cfg, train_set)
+        self._row_weight = jnp.ones(self.num_data, jnp.float32)
+        self._full_feat_mask = jnp.ones(self.num_features, bool)
+        # a fresh jit: the old one captured the previous dataset's labels
+        # (objective.init state) as compile-time constants
+        self._grad_fn = jax.jit(self.objective.gradients)
+        self._grow_fn = self._make_grow_fn()
+        for i, tree in enumerate(self._models):
+            self._add_host_tree_to(self.train_data, tree, i % self.num_class)
+
     def add_valid_dataset(self, valid_set: BinnedDataset) -> None:
         """GBDT::AddValidDataset (gbdt.cpp:169-199)."""
         dd = _DeviceData(valid_set, self.num_class)
@@ -266,7 +290,8 @@ class GBDT:
         if not pend:
             return
         self._pending_iter = None
-        host = jax.device_get([packed for packed, _, _ in pend])
+        with timetag.scope("GBDT::host_tree"):
+            host = jax.device_get([packed for packed, _, _ in pend])
         L = self.grow_params.num_leaves
         trees = [Tree.from_arrays(unpack_tree_arrays(iv, fv, L),
                                   self.train_set.mappers,
@@ -299,12 +324,23 @@ class GBDT:
         round for the popped iteration, with metrics unchanged from the
         round before.  The flag is cleared on detection so an explicit retry
         re-attempts growth, as the reference would."""
-        if grad is None or hess is None:
-            grad, hess = self._gradients()
-        else:
-            grad = jnp.asarray(grad, jnp.float32).reshape(self.num_class, -1)
-            hess = jnp.asarray(hess, jnp.float32).reshape(self.num_class, -1)
-        row_weight = self._bagging_mask(self.iter_)
+        if self._no_more_splits:
+            # saturation detected by an out-of-band flush (models getter,
+            # reset_config, rollback): deliver the stop signal without
+            # dispatching — and clear it so a later retry trains afresh
+            self._no_more_splits = False
+            return True
+        with timetag.scope("GBDT::boosting") as tt:
+            if grad is None or hess is None:
+                grad, hess = self._gradients()
+            else:
+                grad = jnp.asarray(grad, jnp.float32).reshape(
+                    self.num_class, -1)
+                hess = jnp.asarray(hess, jnp.float32).reshape(
+                    self.num_class, -1)
+            tt.sync((grad, hess))
+        with timetag.scope("GBDT::bagging"):
+            row_weight = self._bagging_mask(self.iter_)
         if self._lr_cache[0] != self.shrinkage_rate:
             self._lr_cache = (self.shrinkage_rate,
                               jnp.float32(self.shrinkage_rate))
@@ -312,15 +348,22 @@ class GBDT:
         cur = []
         for cls in range(self.num_class):
             feat_mask = self._feature_mask()
-            tree_arrays, leaf_id, delta = self._grow_fn(
-                self.train_data.bins, self.num_bin, self.is_cat, feat_mask,
-                grad[cls], hess[cls], row_weight, lr_dev)
-            self.train_data.score = self.train_data.score.at[cls].add(delta)
+            with timetag.scope("GBDT::tree") as tt:
+                tree_arrays, leaf_id, delta = self._grow_fn(
+                    self.train_data.bins, self.num_bin, self.is_cat,
+                    feat_mask, grad[cls], hess[cls], row_weight, lr_dev)
+                tt.sync(delta)
+            with timetag.scope("GBDT::train_score") as tt:
+                self.train_data.score = \
+                    self.train_data.score.at[cls].add(delta)
+                tt.sync(self.train_data.score)
             vdeltas = []
-            for dd in self.valid_data:
-                vd = self._device_tree_delta(dd, tree_arrays)
-                dd.score = dd.score.at[cls].add(vd)
-                vdeltas.append(vd)
+            with timetag.scope("GBDT::valid_score") as tt:
+                for dd in self.valid_data:
+                    vd = self._device_tree_delta(dd, tree_arrays)
+                    dd.score = dd.score.at[cls].add(vd)
+                    vdeltas.append(vd)
+                tt.sync(vdeltas)
             cur.append((self._pack_fn(tree_arrays), delta, vdeltas))
         self.iter_ += 1
         shrink = self.shrinkage_rate
@@ -381,10 +424,15 @@ class GBDT:
             dd.score = dd.score.at[cls].add(float(tree.leaf_value[0])
                                             if tree.num_leaves else 0.0)
             return
-        inner = np.asarray([self.train_set.real_to_inner[f]
-                            for f in tree.split_feature], np.int32)
+        # loaded (from_string) trees carry raw thresholds only; rebuild the
+        # bin-space split representation against THIS dataset's mappers
+        if not tree.ensure_inner(self.train_set.real_to_inner,
+                                 self.train_set.mappers):
+            log.fatal("Cannot replay a loaded tree on this dataset: it "
+                      "splits on a feature the dataset binned as trivial")
         delta, _ = predict_binned_tree(
-            jnp.asarray(inner), jnp.asarray(tree.threshold_in_bin),
+            jnp.asarray(tree.split_feature_inner),
+            jnp.asarray(tree.threshold_in_bin),
             jnp.asarray(tree.decision_type == 1),
             jnp.asarray(tree.left_child), jnp.asarray(tree.right_child),
             jnp.asarray(tree.leaf_value, jnp.float32), dd.bins,
@@ -398,10 +446,12 @@ class GBDT:
         cfg = self.config
         out_lines = []
         if cfg.is_training_metric and self.train_metrics:
-            score = np.asarray(self.train_data.score, np.float64)
-            for m in self.train_metrics:
-                for name, v in zip(m.names, m.eval(score)):
-                    out_lines.append(f"Iteration:{self.iter_}, training {name} : {v:g}")
+            with timetag.scope("GBDT::metric"):
+                score = np.asarray(self.train_data.score, np.float64)
+                for m in self.train_metrics:
+                    for name, v in zip(m.names, m.eval(score)):
+                        out_lines.append(
+                            f"Iteration:{self.iter_}, training {name} : {v:g}")
         stop = False
         for vi, (dd, metrics) in enumerate(zip(self.valid_data,
                                                self.valid_metrics)):
@@ -430,6 +480,10 @@ class GBDT:
 
     def eval_metrics(self) -> Dict[str, Dict[str, float]]:
         """All current metric values, for callbacks/evals_result."""
+        with timetag.scope("GBDT::metric"):
+            return self._eval_metrics_impl()
+
+    def _eval_metrics_impl(self) -> Dict[str, Dict[str, float]]:
         out: Dict[str, Dict[str, float]] = {}
         if self.train_metrics:
             score = np.asarray(self.train_data.score, np.float64)
@@ -460,15 +514,78 @@ class GBDT:
 
     # ------------------------------------------------------------------
     # Prediction (host entry: raw feature values)
+
+    _DEVICE_PREDICT_MIN_ROWS = 4096
+
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
-        """[K, n] raw scores (GBDT::PredictRaw, gbdt.cpp:791-798)."""
+        """[K, n] raw scores (GBDT::PredictRaw, gbdt.cpp:791-798).
+
+        Large batches take the device path (the parallel-Predictor
+        equivalent, predictor.hpp:81-129): rows are binned with the
+        training mappers on the host (f64-exact, so integer bin compares
+        ROUTE rows identically to the reference's double threshold
+        compares; the forest sum itself is Kahan-compensated f32, ~1e-7
+        relative of the f64 host sum).  Small batches and mapper-less
+        loaded models use the vectorized host walk."""
         X = np.asarray(X, np.float64)
         n_models = len(self.models)
         if num_iteration > 0:
             n_models = min(n_models, num_iteration * self.num_class)
+        if (X.shape[0] >= self._DEVICE_PREDICT_MIN_ROWS and n_models > 0
+                and getattr(self, "train_set", None) is not None
+                and self.train_set.mappers
+                and all(t.ensure_inner(self.train_set.real_to_inner,
+                                       self.train_set.mappers)
+                        for t in self.models[:n_models])):
+            return self._predict_raw_device(X, n_models)
         out = np.zeros((self.num_class, X.shape[0]), np.float64)
         for i in range(n_models):
             out[i % self.num_class] += self.models[i].predict(X)
+        return out
+
+    def _predict_raw_device(self, X: np.ndarray, n_models: int) -> np.ndarray:
+        ts = self.train_set
+        n = X.shape[0]
+        # host walk sends NaN right (NaN <= th is False); route identically
+        # by mapping NaN to +inf before binning (last bin > any threshold)
+        X = np.where(np.isnan(X), np.inf, X)
+        bins_np = np.zeros((len(ts.used_feature_map), n), dtype=np.int32)
+        for inner, f in enumerate(ts.used_feature_map):
+            bins_np[inner] = ts.mappers[inner].value_to_bin(X[:, f])
+        bins = jnp.asarray(bins_np)
+        # continued training may hold trees larger than grow_params allows
+        L = max(max(t.num_leaves for t in self.models[:n_models]), 2)
+        out = np.zeros((self.num_class, n), np.float64)
+        for cls in range(self.num_class):
+            trees = self.models[cls:n_models:self.num_class]
+            if not trees:
+                continue
+            T = len(trees)
+            sf = np.zeros((T, max(L - 1, 1)), np.int32)
+            sb = np.zeros((T, max(L - 1, 1)), np.int32)
+            ic = np.zeros((T, max(L - 1, 1)), bool)
+            lc = np.zeros((T, max(L - 1, 1)), np.int32)
+            rc = np.zeros((T, max(L - 1, 1)), np.int32)
+            lv = np.zeros((T, L), np.float32)
+            for t, tree in enumerate(trees):
+                k = tree.num_leaves - 1
+                if k <= 0:
+                    lv[t, 0] = tree.leaf_value[0] if tree.num_leaves else 0.0
+                    # no nodes: make the walk stay at node 0 -> leaf 0
+                    lc[t, 0] = ~0
+                    rc[t, 0] = ~0
+                    continue
+                sf[t, :k] = tree.split_feature_inner
+                sb[t, :k] = tree.threshold_in_bin
+                ic[t, :k] = tree.decision_type == 1
+                lc[t, :k] = tree.left_child
+                rc[t, :k] = tree.right_child
+                lv[t, :tree.num_leaves] = tree.leaf_value
+            val = predict_binned_forest(
+                jnp.asarray(sf), jnp.asarray(sb), jnp.asarray(ic),
+                jnp.asarray(lc), jnp.asarray(rc), jnp.asarray(lv),
+                bins, L)
+            out[cls] = np.asarray(val, np.float64)
         return out
 
     def predict(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:

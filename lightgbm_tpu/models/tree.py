@@ -95,7 +95,36 @@ class Tree:
         t.internal_count = np.asarray(tree_arrays.internal_count,
                                       dtype=np.int32)[:n]
         t.shrinkage = learning_rate
+        t.inner_valid = True
         return t
+
+    def ensure_inner(self, real_to_inner, mappers) -> bool:
+        """Make split_feature_inner / threshold_in_bin valid against the
+        given dataset (BinMapper::ValueToBin of the raw threshold — the
+        reference's threshold_in_bin_ reconstruction for loaded models).
+        Returns False when a split feature is not usable in this dataset
+        (trivial/ignored there), in which case callers must stay on the
+        raw-value host path."""
+        if getattr(self, "inner_valid", False) and \
+                getattr(self, "_inner_mappers", None) in (None, id(mappers)):
+            return True
+        n = self.num_leaves - 1
+        if n <= 0:
+            self.inner_valid = True
+            return True
+        inner = np.asarray([int(real_to_inner[f])
+                            for f in self.split_feature], np.int32)
+        if (inner < 0).any():
+            return False
+        tbin = np.zeros(n, np.int32)
+        for i in range(n):
+            tbin[i] = int(mappers[inner[i]].value_to_bin(
+                np.asarray([self.threshold[i]]))[0])
+        self.split_feature_inner = inner
+        self.threshold_in_bin = tbin
+        self.inner_valid = True
+        self._inner_mappers = id(mappers)
+        return True
 
     # ------------------------------------------------------------------
     def predict(self, X: np.ndarray) -> np.ndarray:

@@ -124,34 +124,41 @@ class SerialComm(NamedTuple):
         N = step.leaf_id.shape[0]
         classes = leafhist.size_classes(N)
 
-        # Raw (unweighted) row counts decide which child is smaller, like
-        # the reference's data-count rule (serial_tree_learner.cpp:404-420).
-        cnt_r = jnp.sum((step.in_leaf & step.go_right).astype(jnp.int32))
-        cnt_in = jnp.sum(step.in_leaf.astype(jnp.int32))
-        cnt_l = cnt_in - cnt_r
-        small_is_left = cnt_l <= cnt_r
-        mask_small = step.in_leaf & jnp.where(small_is_left, ~step.go_right,
-                                              step.go_right)
-        small_cnt = jnp.minimum(cnt_l, cnt_r)
+        # TIMETAG phase names (serial_tree_learner.cpp:10-37) as trace
+        # annotations: jax.profiler device traces group ops by these.
+        with jax.named_scope("hist"):
+            # Raw (unweighted) row counts decide which child is smaller,
+            # like the reference's data-count rule
+            # (serial_tree_learner.cpp:404-420).
+            cnt_r = jnp.sum((step.in_leaf & step.go_right).astype(jnp.int32))
+            cnt_in = jnp.sum(step.in_leaf.astype(jnp.int32))
+            cnt_l = cnt_in - cnt_r
+            small_is_left = cnt_l <= cnt_r
+            mask_small = step.in_leaf & jnp.where(small_is_left,
+                                                  ~step.go_right,
+                                                  step.go_right)
+            small_cnt = jnp.minimum(cnt_l, cnt_r)
 
-        sums_small = leafhist.leaf_histogram(prep.bins_rm, prep.digits,
-                                             mask_small, small_cnt,
-                                             max_bin, classes)
-        sums_parent = cache[step.parent_leaf]          # [F, 9, B] i32
-        sums_large = sums_parent - sums_small          # EXACT sibling
-        sums_left = jnp.where(small_is_left, sums_small, sums_large)
-        sums_right = jnp.where(small_is_left, sums_large, sums_small)
+            sums_small = leafhist.leaf_histogram(prep.bins_rm, prep.digits,
+                                                 mask_small, small_cnt,
+                                                 max_bin, classes)
+            sums_parent = cache[step.parent_leaf]      # [F, 9, B] i32
+            sums_large = sums_parent - sums_small      # EXACT sibling
+            sums_left = jnp.where(small_is_left, sums_small, sums_large)
+            sums_right = jnp.where(small_is_left, sums_large, sums_small)
 
-        keep = step.do_split
-        cache = cache.at[step.parent_leaf].set(
-            jnp.where(keep, sums_left, sums_parent))
-        cache = cache.at[step.right_leaf].set(
-            jnp.where(keep, sums_right, cache[step.right_leaf]), mode="drop")
+            keep = step.do_split
+            cache = cache.at[step.parent_leaf].set(
+                jnp.where(keep, sums_left, sums_parent))
+            cache = cache.at[step.right_leaf].set(
+                jnp.where(keep, sums_right, cache[step.right_leaf]),
+                mode="drop")
 
-        hists = leafhist.combine_digit_sums(
-            jnp.stack([sums_left, sums_right]), prep.scales)  # [2, F, B, 3]
-        split = find_best_split(hists, totals_g, totals_h, totals_c,
-                                num_bin, is_cat, feat_mask, can, sp)
+        with jax.named_scope("find_split"):
+            hists = leafhist.combine_digit_sums(
+                jnp.stack([sums_left, sums_right]), prep.scales)
+            split = find_best_split(hists, totals_g, totals_h, totals_c,
+                                    num_bin, is_cat, feat_mask, can, sp)
         return split, cache
 
 
@@ -350,12 +357,14 @@ def _grow_tree_impl(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
 
         # --- partition: rows of best_leaf with bin > t (numerical) or
         # bin != t (categorical) move to the right child -------------------
-        fbin = jnp.take(bins, jnp.maximum(feat, 0), axis=0).astype(jnp.int32)
-        go_right = jnp.where(is_cat[jnp.maximum(feat, 0)],
-                             fbin != tbin, fbin > tbin)
-        in_leaf = state.leaf_id == best_leaf
-        new_leaf_id = jnp.where(do_split & in_leaf & go_right,
-                                right_leaf, state.leaf_id)
+        with jax.named_scope("split"):
+            fbin = jnp.take(bins, jnp.maximum(feat, 0),
+                            axis=0).astype(jnp.int32)
+            go_right = jnp.where(is_cat[jnp.maximum(feat, 0)],
+                                 fbin != tbin, fbin > tbin)
+            in_leaf = state.leaf_id == best_leaf
+            new_leaf_id = jnp.where(do_split & in_leaf & go_right,
+                                    right_leaf, state.leaf_id)
 
         # --- split sums ---------------------------------------------------
         parent_g = state.total_g[best_leaf]

@@ -61,15 +61,22 @@ def predict_binned_forest(split_feature, split_bin, is_cat_node, left_child,
     over all T trees, [N] f32.  For multiclass, call per class with that
     class's tree stack.
     """
-    def body(acc, tree):
+    def body(carry, tree):
+        acc, comp = carry
         sf, sb, ic, lc, rc, lv = tree
         val, _ = predict_binned_tree(sf, sb, ic, lc, rc, lv, bins, max_steps)
-        return acc + val, None
+        # Kahan-compensated sum: TPUs run f32; the compensation keeps the
+        # forest total within ~1 ulp of the host's f64 accumulation
+        y = val - comp
+        t = acc + y
+        comp = (t - acc) - y
+        return (t, comp), None
 
     N = bins.shape[1]
-    init = jnp.zeros(N, dtype=jnp.float32)
-    out, _ = jax.lax.scan(body, init, (split_feature, split_bin, is_cat_node,
-                                       left_child, right_child, leaf_value))
+    init = (jnp.zeros(N, dtype=jnp.float32), jnp.zeros(N, dtype=jnp.float32))
+    (out, _), _ = jax.lax.scan(body, init,
+                               (split_feature, split_bin, is_cat_node,
+                                left_child, right_child, leaf_value))
     return out
 
 

@@ -1,0 +1,108 @@
+"""TIMETAG-style phase profiling.
+
+The reference compiles scoped wall-clock accumulators under #ifdef TIMETAG
+(serial_tree_learner.cpp:10-37: init_train/init_split/hist/find_split/
+split; gbdt.cpp:20-59: boosting/train_score/valid_score/metric/bagging/
+tree) and prints the totals at shutdown.  Here the same phase taxonomy is
+kept, adapted to an async device:
+
+- ``scope(name, sync=...)`` — host wall-clock accumulator.  Enabled by
+  LIGHTGBM_TPU_TIMETAG=1; when ``sync`` is given the scope blocks on that
+  device value before stopping the clock, so device time is attributed to
+  the phase that produced it (this serializes the pipeline exactly like
+  the reference's TIMETAG builds perturb theirs — a measurement mode, not
+  a production mode).
+- jitted code carries ``jax.named_scope`` annotations with the same phase
+  names (ops/grow.py), so device-side traces captured with
+  jax.profiler.trace() break down by phase without any re-run.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict
+
+from . import log
+
+ENABLED = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
+
+
+def enable(on: bool = True) -> None:
+    """Programmatic switch (the env var only sets the initial state)."""
+    global ENABLED
+    ENABLED = on
+
+_acc: Dict[str, float] = defaultdict(float)
+_cnt: Dict[str, int] = defaultdict(int)
+
+
+class _Sync:
+    """Collects device values to block on when the scope closes."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = None
+
+    def sync(self, value) -> None:
+        self.value = value
+
+
+class _NoopSync:
+    """Disabled mode: must NOT retain the passed device buffers (a stored
+    reference would pin grad/score arrays in HBM for the process
+    lifetime)."""
+
+    __slots__ = ()
+
+    def sync(self, value) -> None:
+        pass
+
+
+_NOOP = _NoopSync()
+
+
+@contextmanager
+def scope(name: str):
+    """Accumulate wall time under ``name``.  The yielded object's
+    ``sync(x)`` registers device values to block on before the clock
+    stops, so async device work is attributed to the phase that produced
+    it."""
+    if not ENABLED:
+        yield _NOOP
+        return
+    s = _Sync()
+    t0 = time.perf_counter()
+    try:
+        yield s
+    finally:
+        if s.value is not None:
+            import jax
+            jax.block_until_ready(s.value)
+        _acc[name] += time.perf_counter() - t0
+        _cnt[name] += 1
+
+
+def get_timings() -> Dict[str, float]:
+    return dict(_acc)
+
+
+def reset() -> None:
+    _acc.clear()
+    _cnt.clear()
+
+
+def report() -> None:
+    """Print accumulated phase costs (GBDT::~GBDT's 'xxx costs:' lines)."""
+    for name in sorted(_acc):
+        log.info("%s costs: %f (%d calls)", name, _acc[name], _cnt[name])
+
+
+@atexit.register
+def _report_at_exit() -> None:
+    if ENABLED and _acc:
+        report()

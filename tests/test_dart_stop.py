@@ -150,3 +150,21 @@ def test_reset_config_flushes_before_num_leaves_change():
     # leaf values of the first tree must be sane (not misaligned garbage)
     assert np.all(np.isfinite(trees[0].leaf_value))
     assert np.max(np.abs(trees[0].leaf_value)) < 100
+
+
+def test_out_of_band_saturation_flush_is_delivered_not_destructive():
+    """If reset_config/models-access flushes a pending saturated iteration,
+    the NEXT train_one_iter must report the stop without discarding any
+    newly grown trees or crashing."""
+    from lightgbm_tpu.models.gbdt import GBDT
+    X, y = _small_ds(n=100)
+    cfg = Config({"objective": "regression", "num_leaves": 7,
+                  "min_gain_to_split": 1e12, "metric": "none"})
+    ds = BinnedDataset.from_matrix(X, y, max_bin=32, min_data_in_leaf=10)
+    b = GBDT(cfg, ds)
+    assert b.train_one_iter() is False        # saturated iteration pending
+    _ = b.models                              # out-of-band flush detects it
+    assert b.train_one_iter() is True         # signal delivered, no dispatch
+    # a later explicit retry trains afresh (reference behavior)
+    assert b.train_one_iter() is False
+    assert b.iter_ >= 1

@@ -1,0 +1,38 @@
+"""TIMETAG phase profiling (utils/timetag.py): the reference's phase
+taxonomy (gbdt.cpp:20-59, serial_tree_learner.cpp:10-37) accumulated
+host-side with device sync, plus named_scope annotations in the grower."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils import timetag
+
+
+def test_phase_accumulators():
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(500, 5))
+    y = (X[:, 0] > 0).astype(np.float64)
+    timetag.enable(True)
+    timetag.reset()
+    try:
+        ds = lgb.Dataset(X, label=y)
+        vs = ds.create_valid(X[:100], y[:100])
+        lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                   "metric": "auc", "is_training_metric": True},
+                  ds, num_boost_round=3, valid_sets=[vs])
+        t = timetag.get_timings()
+    finally:
+        timetag.enable(False)
+    for phase in ("GBDT::boosting", "GBDT::tree", "GBDT::train_score",
+                  "GBDT::valid_score", "GBDT::host_tree", "GBDT::metric"):
+        assert phase in t and t[phase] >= 0.0, (phase, t)
+    timetag.reset()
+    assert timetag.get_timings() == {}
+
+
+def test_disabled_is_noop():
+    timetag.enable(False)
+    timetag.reset()
+    with timetag.scope("x") as s:
+        s.sync(np.zeros(3))
+    assert timetag.get_timings() == {}
