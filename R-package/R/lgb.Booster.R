@@ -1,0 +1,158 @@
+# lgb.Booster: R6 wrapper of lightgbm_tpu.Booster.
+#
+# Reference surface: R-package/R/lgb.Booster.R:1-475 (update, rollback,
+# eval, predict, save/load/dump, best_iter/record_evals) — here delegated
+# to the Python Booster, whose semantics are already pinned against the
+# reference by the Python test-suite.
+
+Booster <- R6::R6Class(
+  "lgb.Booster",
+  public = list(
+    py = NULL,
+    best_iter = -1L,
+    record_evals = list(),
+
+    initialize = function(params = list(), train_set = NULL,
+                          modelfile = NULL, model_str = NULL,
+                          py_handle = NULL) {
+      if (!is.null(py_handle)) {
+        # wrap an existing Python Booster (used by lgb.train) without a
+        # save/parse round-trip of the whole forest
+        self$py <- py_handle
+        return(invisible(self))
+      }
+      lgb <- lgb.get.module()
+      if (!is.null(train_set)) {
+        lgb.check.r6(train_set, "lgb.Dataset", "lgb.Booster")
+        self$py <- lgb$Booster(params = params, train_set = train_set$py)
+      } else if (!is.null(modelfile)) {
+        self$py <- lgb$Booster(model_file = modelfile)
+      } else if (!is.null(model_str)) {
+        tmp <- tempfile(fileext = ".txt")
+        writeLines(model_str, tmp)
+        self$py <- lgb$Booster(model_file = tmp)
+        unlink(tmp)
+      } else {
+        stop("lgb.Booster: need train_set, modelfile or model_str")
+      }
+      invisible(self)
+    },
+
+    add_valid = function(data, name) {
+      lgb.check.r6(data, "lgb.Dataset", "add_valid")
+      self$py$add_valid(data$py, name)
+      invisible(self)
+    },
+
+    update = function(train_set = NULL, fobj = NULL) {
+      if (!is.null(train_set)) {
+        stop("update(train_set=...) is not supported; create a new booster")
+      }
+      if (is.null(fobj)) {
+        self$py$update()
+      } else {
+        stop("custom fobj through R is not yet wired; use the Python API")
+      }
+      invisible(self)
+    },
+
+    rollback_one_iter = function() {
+      self$py$rollback_one_iter()
+      invisible(self)
+    },
+
+    current_iter = function() {
+      self$py$current_iteration()
+    },
+
+    eval = function(data, name, feval = NULL) {
+      lgb.check.r6(data, "lgb.Dataset", "eval")
+      reticulate::py_to_r(self$py$eval(data$py, name))
+    },
+
+    eval_train = function(feval = NULL) {
+      reticulate::py_to_r(self$py$eval_train())
+    },
+
+    eval_valid = function(feval = NULL) {
+      reticulate::py_to_r(self$py$eval_valid())
+    },
+
+    save_model = function(filename, num_iteration = -1L) {
+      self$py$save_model(filename, as.integer(num_iteration))
+      invisible(self)
+    },
+
+    save_model_to_string = function(num_iteration = -1L) {
+      self$py$model_to_string(as.integer(num_iteration))
+    },
+
+    dump_model = function(num_iteration = -1L) {
+      reticulate::py_to_r(self$py$dump_model(as.integer(num_iteration)))
+    },
+
+    predict = function(data, num_iteration = NULL, rawscore = FALSE,
+                       predleaf = FALSE, header = FALSE, reshape = TRUE) {
+      if (is.null(num_iteration)) {
+        num_iteration <- -1L
+      }
+      payload <- if (is.character(data)) data else lgb.as.matrix(data)
+      out <- self$py$predict(
+        payload, num_iteration = as.integer(num_iteration),
+        raw_score = rawscore, pred_leaf = predleaf,
+        data_has_header = header, is_reshape = reshape)
+      reticulate::py_to_r(out)
+    },
+
+    feature_importance = function(importance_type = "split") {
+      as.vector(reticulate::py_to_r(
+        self$py$feature_importance(importance_type)))
+    }
+  )
+)
+
+#' Create a Booster (reference lgb.Booster.R)
+lgb.Booster <- function(params = list(), train_set = NULL,
+                        modelfile = NULL, model_str = NULL) {
+  Booster$new(params, train_set, modelfile, model_str)
+}
+
+lgb.load <- function(filename = NULL, model_str = NULL) {
+  Booster$new(modelfile = filename, model_str = model_str)
+}
+
+lgb.save <- function(booster, filename, num_iteration = -1L) {
+  lgb.check.r6(booster, "lgb.Booster", "lgb.save")
+  booster$save_model(filename, num_iteration)
+}
+
+lgb.dump <- function(booster, num_iteration = -1L) {
+  lgb.check.r6(booster, "lgb.Booster", "lgb.dump")
+  booster$dump_model(num_iteration)
+}
+
+lgb.importance <- function(model, percentage = TRUE) {
+  lgb.check.r6(model, "lgb.Booster", "lgb.importance")
+  imp <- model$feature_importance()
+  if (percentage && sum(imp) > 0) {
+    imp <- imp / sum(imp)
+  }
+  imp
+}
+
+lgb.get.eval.result <- function(booster, data_name, eval_name,
+                                iters = NULL, is_err = FALSE) {
+  rec <- booster$record_evals[[data_name]][[eval_name]]
+  if (is.null(rec)) {
+    stop(sprintf("no eval results for (%s, %s)", data_name, eval_name))
+  }
+  out <- unlist(rec$eval)
+  if (!is.null(iters)) {
+    out <- out[iters]
+  }
+  out
+}
+
+predict.lgb.Booster <- function(object, data, ...) {
+  object$predict(data, ...)
+}

@@ -1,0 +1,154 @@
+# lgb.Dataset: R6 wrapper of lightgbm_tpu.Dataset.
+#
+# Reference surface: R-package/R/lgb.Dataset.R:404-738 (lgb.Dataset,
+# lgb.Dataset.create.valid, lgb.Dataset.construct, lgb.Dataset.save,
+# dim/dimnames/slice, getinfo/setinfo).
+
+Dataset <- R6::R6Class(
+  "lgb.Dataset",
+  public = list(
+    py = NULL,
+
+    initialize = function(data, params = list(), reference = NULL,
+                          colnames = NULL, categorical_feature = NULL,
+                          free_raw_data = TRUE, info = list(), ...) {
+      lgb <- lgb.get.module()
+      info <- c(info, list(...))
+      if (is.character(data)) {
+        payload <- data               # file path, parsed by the core
+      } else {
+        payload <- lgb.as.matrix(data)
+      }
+      ref_py <- if (!is.null(reference)) reference$py else NULL
+      feat <- if (is.null(colnames)) "auto" else as.list(colnames)
+      cat_feat <- if (is.null(categorical_feature)) "auto" else
+        as.list(categorical_feature)
+      self$py <- lgb$Dataset(
+        data = payload,
+        label = info[["label"]],
+        weight = info[["weight"]],
+        group = info[["group"]],
+        params = params,
+        feature_name = feat,
+        categorical_feature = cat_feat,
+        free_raw_data = free_raw_data)
+      if (!is.null(info[["init_score"]])) {
+        self$setinfo("init_score", info[["init_score"]])
+      }
+      invisible(self)
+    },
+
+    construct = function() {
+      self$py$construct()
+      invisible(self)
+    },
+
+    create_valid = function(data, info = list(), ...) {
+      info <- c(info, list(...))
+      valid <- Dataset$new(data, reference = self)
+      for (k in names(info)) {
+        valid$setinfo(k, info[[k]])
+      }
+      valid
+    },
+
+    dim = function() {
+      c(self$py$num_data(), self$py$num_feature())
+    },
+
+    get_colnames = function() {
+      unlist(reticulate::py_to_r(self$py$construct()$`_binned`$feature_names))
+    },
+
+    setinfo = function(name, info) {
+      switch(name,
+             label = self$py$set_label(reticulate::np_array(as.double(info))),
+             weight = self$py$set_weight(reticulate::np_array(as.double(info))),
+             init_score = self$py$set_init_score(
+               reticulate::np_array(as.double(info))),
+             group = self$py$set_group(reticulate::np_array(as.integer(info))),
+             stop(sprintf("setinfo: unknown field %s", name)))
+      invisible(self)
+    },
+
+    getinfo = function(name) {
+      out <- switch(name,
+                    label = self$py$get_label(),
+                    weight = self$py$get_weight(),
+                    init_score = self$py$get_init_score(),
+                    group = self$py$get_group(),
+                    stop(sprintf("getinfo: unknown field %s", name)))
+      if (is.null(out)) NULL else as.vector(reticulate::py_to_r(out))
+    },
+
+    slice = function(idxset) {
+      sub <- Dataset$new(matrix(0, 1, 1))  # placeholder, replaced below
+      sub$py <- self$py$subset(reticulate::np_array(
+        as.integer(idxset) - 1L))          # R is 1-based
+      sub
+    },
+
+    save_binary = function(fname) {
+      self$py$save_binary(fname)
+      invisible(self)
+    },
+
+    set_reference = function(reference) {
+      lgb.check.r6(reference, "lgb.Dataset", "set_reference")
+      self$py$set_reference(reference$py)
+      invisible(self)
+    },
+
+    set_categorical_feature = function(categorical_feature) {
+      self$py$set_categorical_feature(as.list(categorical_feature))
+      invisible(self)
+    }
+  )
+)
+
+#' Construct a lgb.Dataset (reference lgb.Dataset, lgb.Dataset.R:404)
+lgb.Dataset <- function(data, params = list(), reference = NULL,
+                        colnames = NULL, categorical_feature = NULL,
+                        free_raw_data = TRUE, info = list(), ...) {
+  Dataset$new(data, params, reference, colnames, categorical_feature,
+              free_raw_data, info, ...)
+}
+
+lgb.Dataset.create.valid <- function(dataset, data, info = list(), ...) {
+  lgb.check.r6(dataset, "lgb.Dataset", "lgb.Dataset.create.valid")
+  dataset$create_valid(data, info, ...)
+}
+
+lgb.Dataset.construct <- function(dataset) {
+  lgb.check.r6(dataset, "lgb.Dataset", "lgb.Dataset.construct")
+  dataset$construct()
+}
+
+lgb.Dataset.save <- function(dataset, fname) {
+  lgb.check.r6(dataset, "lgb.Dataset", "lgb.Dataset.save")
+  dataset$save_binary(fname)
+}
+
+lgb.Dataset.set.categorical <- function(dataset, categorical_feature) {
+  dataset$set_categorical_feature(categorical_feature)
+}
+
+lgb.Dataset.set.reference <- function(dataset, reference) {
+  dataset$set_reference(reference)
+}
+
+setinfo <- function(dataset, name, info, ...) {
+  dataset$setinfo(name, info)
+}
+
+getinfo <- function(dataset, name, ...) {
+  dataset$getinfo(name)
+}
+
+dim.lgb.Dataset <- function(x, ...) {
+  x$dim()
+}
+
+dimnames.lgb.Dataset <- function(x) {
+  list(NULL, x$get_colnames())
+}

@@ -1,0 +1,105 @@
+# lgb.train / lgb.cv / lightgbm: the training entries.
+#
+# Reference surface: R-package/R/lgb.train.R:49-175, lgb.cv.R:73-290,
+# lightgbm.R:6-48.  The boosting loop, early stopping and evals_result
+# recording run in the Python engine (engine.train / engine.cv), which the
+# Python test-suite pins against the reference iteration for iteration.
+
+lgb.train <- function(params = list(), data, nrounds = 10,
+                      valids = list(),
+                      obj = NULL,
+                      eval = NULL,
+                      verbose = 1,
+                      record = TRUE,
+                      eval_freq = 1L,
+                      init_model = NULL,
+                      colnames = NULL,
+                      categorical_feature = NULL,
+                      early_stopping_rounds = NULL,
+                      callbacks = list(), ...) {
+  lgb <- lgb.get.module()
+  lgb.check.r6(data, "lgb.Dataset", "lgb.train")
+  params <- lgb.params2list(params, ...)
+  if (!is.null(obj)) {
+    params$objective <- obj
+  }
+  if (!is.null(eval)) {
+    params$metric <- eval
+  }
+  params$verbose <- verbose
+  valid_sets <- lapply(valids, function(v) v$py)
+  valid_names <- names(valids)
+  evals_result <- reticulate::dict()    # engine records into a Python dict
+  init_tmp <- NULL
+  init <- if (inherits(init_model, "lgb.Booster")) {
+    init_tmp <- tempfile(fileext = ".txt")
+    init_model$save_model(init_tmp)
+    init_tmp
+  } else {
+    init_model
+  }
+  on.exit(if (!is.null(init_tmp)) unlink(init_tmp), add = TRUE)
+  py_booster <- lgb$train(
+    params = params,
+    train_set = data$py,
+    num_boost_round = as.integer(nrounds),
+    valid_sets = if (length(valid_sets)) valid_sets else NULL,
+    valid_names = if (length(valid_names)) as.list(valid_names) else NULL,
+    early_stopping_rounds = if (is.null(early_stopping_rounds)) NULL else
+      as.integer(early_stopping_rounds),
+    evals_result = evals_result,
+    verbose_eval = if (verbose > 0) as.integer(eval_freq) else FALSE,
+    init_model = init)
+  out <- Booster$new(py_handle = py_booster)
+  out$best_iter <- py_booster$best_iteration
+  if (record) {
+    out$record_evals <- reticulate::py_to_r(evals_result)
+  }
+  out
+}
+
+lgb.cv <- function(params = list(), data, nrounds = 10, nfold = 3,
+                   label = NULL, weight = NULL, obj = NULL, eval = NULL,
+                   verbose = 1, record = TRUE, eval_freq = 1L,
+                   showsd = TRUE, stratified = TRUE, folds = NULL,
+                   init_model = NULL, colnames = NULL,
+                   categorical_feature = NULL,
+                   early_stopping_rounds = NULL, callbacks = list(), ...) {
+  lgb <- lgb.get.module()
+  lgb.check.r6(data, "lgb.Dataset", "lgb.cv")
+  params <- lgb.params2list(params, ...)
+  if (!is.null(obj)) {
+    params$objective <- obj
+  }
+  if (!is.null(eval)) {
+    params$metric <- eval
+  }
+  out <- lgb$cv(
+    params = params,
+    train_set = data$py,
+    num_boost_round = as.integer(nrounds),
+    nfold = as.integer(nfold),
+    stratified = stratified,
+    early_stopping_rounds = if (is.null(early_stopping_rounds)) NULL else
+      as.integer(early_stopping_rounds),
+    verbose_eval = if (verbose > 0) as.integer(eval_freq) else FALSE)
+  reticulate::py_to_r(out)
+}
+
+lightgbm <- function(data, label = NULL, weight = NULL,
+                     params = list(), nrounds = 10,
+                     verbose = 1, eval_freq = 1L,
+                     early_stopping_rounds = NULL,
+                     save_name = "lightgbm.model",
+                     init_model = NULL, callbacks = list(), ...) {
+  dtrain <- if (inherits(data, "lgb.Dataset")) data else
+    lgb.Dataset(data, info = list(label = label, weight = weight))
+  booster <- lgb.train(params = params, data = dtrain, nrounds = nrounds,
+                       verbose = verbose, eval_freq = eval_freq,
+                       early_stopping_rounds = early_stopping_rounds,
+                       init_model = init_model, callbacks = callbacks, ...)
+  if (!is.null(save_name)) {
+    booster$save_model(save_name)
+  }
+  booster
+}

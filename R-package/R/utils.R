@@ -1,0 +1,41 @@
+# Bridge to the Python core.
+#
+# Reference: R-package/R/utils.R (lgb.call / lgb.params2str plumbing over
+# the C API).  Here the binding rides reticulate directly into the
+# lightgbm_tpu Python package: the Python surface (basic.Dataset,
+# basic.Booster, engine.train/cv) is itself a faithful port of the
+# reference python-package, so the R<->Python mapping stays 1:1 with the
+# reference's R<->C mapping.
+
+.lgb_env <- new.env(parent = emptyenv())
+
+lgb.get.module <- function() {
+  if (is.null(.lgb_env$module)) {
+    .lgb_env$module <- reticulate::import("lightgbm_tpu", delay_load = FALSE)
+  }
+  .lgb_env$module
+}
+
+lgb.params2list <- function(params, ...) {
+  extra <- list(...)
+  for (k in names(extra)) {
+    params[[k]] <- extra[[k]]
+  }
+  params
+}
+
+lgb.check.r6 <- function(x, cls, what) {
+  if (!inherits(x, cls)) {
+    stop(sprintf("%s: expected a %s object", what, cls))
+  }
+  invisible(x)
+}
+
+# data.frame/matrix -> numpy, keeping double precision
+lgb.as.matrix <- function(data) {
+  if (is.data.frame(data)) {
+    data <- as.matrix(data)
+  }
+  storage.mode(data) <- "double"
+  reticulate::np_array(data)
+}

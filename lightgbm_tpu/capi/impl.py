@@ -246,9 +246,8 @@ def _csr_to_dense(indptr, indptr_type, indices, data, data_type,
     if ncol <= 0:
         ncol = int(idx.max()) + 1 if nelem else 0
     X = np.zeros((nrow, ncol), dtype=np.float64)
-    for r in range(nrow):
-        a, b = ip[r], ip[r + 1]
-        X[r, idx[a:b]] = val[a:b]
+    rows = np.repeat(np.arange(nrow), np.diff(ip))
+    X[rows, idx] = val
     return X
 
 
@@ -259,9 +258,8 @@ def _csc_to_dense(col_ptr, col_ptr_type, indices, data, data_type,
     val = _np_from_ptr(data, data_type, int(nelem)).astype(np.float64)
     ncol = int(ncol_ptr) - 1
     X = np.zeros((int(num_row), ncol), dtype=np.float64)
-    for c in range(ncol):
-        a, b = cp[c], cp[c + 1]
-        X[idx[a:b], c] = val[a:b]
+    cols = np.repeat(np.arange(ncol), np.diff(cp))
+    X[idx, cols] = val
     return X
 
 
@@ -309,10 +307,11 @@ def LGBM_DatasetCreateFromFile(filename, parameters, reference, out):
     if BinnedDataset.is_binary_file(path):
         binned = BinnedDataset.load_binary(path)
     else:
+        # alias-resolved config ('header=' -> has_header etc., config.py)
+        cfg = _dataset_params(params)
         label, X, header = parse_file(
-            path, has_header=params.get("has_header", "").lower()
-            in ("true", "1"),
-            label_idx=int(params.get("label_column", 0)))
+            path, has_header=bool(cfg.has_header),
+            label_idx=int(cfg.label_column or 0))
         binned = _binned_from_matrix(X, params, ref)
         if label is not None:
             binned.metadata.set_label(label)

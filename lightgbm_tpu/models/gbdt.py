@@ -200,6 +200,12 @@ class GBDT:
         training metrics against it, and replay the existing models into a
         fresh score buffer."""
         self._flush_pending()
+        old = getattr(self, "train_set", None)
+        if old is not None and not _mappers_aligned(old, train_set):
+            # Dataset::CheckAlign (gbdt.cpp ResetTrainingData): bin-space
+            # tree state is only meaningful against identical mappers
+            log.fatal("Cannot reset training data, since new training data "
+                      "has different bin mappers")
         cfg = self.config
         self.train_set = train_set
         self.num_data = train_set.num_data
@@ -682,6 +688,23 @@ class GBDT:
 
     def num_trees(self) -> int:
         return len(self.models)
+
+
+def _mappers_aligned(a: BinnedDataset, b: BinnedDataset) -> bool:
+    """True when two datasets share identical bin mappers (feature map,
+    bin counts, and boundaries) — Dataset::CheckAlign equivalent."""
+    if a.used_feature_map != b.used_feature_map:
+        return False
+    for ma, mb in zip(a.mappers, b.mappers):
+        if ma is mb:
+            continue
+        if ma.num_bin != mb.num_bin or ma.bin_type != mb.bin_type:
+            return False
+        if not np.array_equal(ma.bin_upper_bound, mb.bin_upper_bound):
+            return False
+        if list(ma.bin_2_categorical) != list(mb.bin_2_categorical):
+            return False
+    return True
 
 
 def _negate_tree(tree: Tree) -> Tree:

@@ -105,8 +105,13 @@ class Tree:
         Returns False when a split feature is not usable in this dataset
         (trivial/ignored there), in which case callers must stay on the
         raw-value host path."""
+        cached = getattr(self, "_inner_mappers_ref", None)
         if getattr(self, "inner_valid", False) and \
-                getattr(self, "_inner_mappers", None) in (None, id(mappers)):
+                (cached is None or cached is mappers):
+            # from_arrays trees are native to the training mappers; all
+            # datasets reaching here are alignment-checked against them
+            # (GBDT._mappers_aligned), so a None ref means "native".  The
+            # strong reference (not id()) is immune to GC address reuse.
             return True
         n = self.num_leaves - 1
         if n <= 0:
@@ -123,7 +128,7 @@ class Tree:
         self.split_feature_inner = inner
         self.threshold_in_bin = tbin
         self.inner_valid = True
-        self._inner_mappers = id(mappers)
+        self._inner_mappers_ref = mappers
         return True
 
     # ------------------------------------------------------------------

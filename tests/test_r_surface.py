@@ -1,0 +1,81 @@
+"""R-binding contract: the build image has no R runtime, so the R package
+(R-package/) cannot be executed here.  This test pins its contract with
+the Python core instead — every Python attribute the R code calls must
+exist with a compatible signature, so R-side breakage can only come from
+the R files themselves, which are thin R6 delegations."""
+
+import inspect
+import os
+import re
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.basic import Booster, Dataset
+
+R_DIR = os.path.join(os.path.dirname(__file__), "..", "R-package", "R")
+
+
+def test_r_package_files_present():
+    files = os.listdir(R_DIR)
+    for needed in ("lgb.Dataset.R", "lgb.Booster.R", "lgb.train.R",
+                   "utils.R"):
+        assert needed in files
+    desc = open(os.path.join(R_DIR, "..", "DESCRIPTION")).read()
+    assert "reticulate" in desc
+
+
+def test_booster_surface_for_r():
+    for method in ("add_valid", "update", "rollback_one_iter",
+                   "current_iteration", "eval", "eval_train", "eval_valid",
+                   "save_model", "model_to_string", "dump_model", "predict",
+                   "feature_importance"):
+        assert callable(getattr(Booster, method)), method
+    sig = inspect.signature(Booster.predict)
+    for kw in ("num_iteration", "raw_score", "pred_leaf", "data_has_header",
+               "is_reshape"):
+        assert kw in sig.parameters, kw
+    sig = inspect.signature(Booster.__init__)
+    for kw in ("params", "train_set", "model_file"):
+        assert kw in sig.parameters, kw
+
+
+def test_dataset_surface_for_r():
+    for method in ("construct", "num_data", "num_feature", "set_label",
+                   "set_weight", "set_init_score", "set_group", "get_label",
+                   "get_weight", "get_init_score", "get_group", "subset",
+                   "save_binary", "set_reference",
+                   "set_categorical_feature"):
+        assert callable(getattr(Dataset, method)), method
+    sig = inspect.signature(Dataset.__init__)
+    for kw in ("data", "label", "weight", "group", "params", "feature_name",
+               "categorical_feature", "free_raw_data"):
+        assert kw in sig.parameters, kw
+
+
+def test_train_cv_surface_for_r():
+    sig = inspect.signature(lgb.train)
+    for kw in ("params", "train_set", "num_boost_round", "valid_sets",
+               "valid_names", "early_stopping_rounds", "evals_result",
+               "verbose_eval", "init_model"):
+        assert kw in sig.parameters, kw
+    sig = inspect.signature(lgb.cv)
+    for kw in ("params", "train_set", "num_boost_round", "nfold",
+               "stratified", "early_stopping_rounds", "verbose_eval"):
+        assert kw in sig.parameters, kw
+
+
+def test_r_code_calls_only_existing_python_attrs():
+    """Grep the R sources for `$py$<name>(` and `lgb$<name>(` call sites
+    and check each against the Python objects."""
+    calls_py = set()
+    calls_mod = set()
+    for fname in os.listdir(R_DIR):
+        src = open(os.path.join(R_DIR, fname)).read()
+        calls_py.update(re.findall(r"\$py\$([A-Za-z_]+)\(", src))
+        calls_py.update(re.findall(r"self\$py\$`?([A-Za-z_]+)`?\$", src))
+        calls_mod.update(re.findall(r"lgb\$([A-Za-z_]+)\(", src))
+    for name in calls_mod:
+        assert hasattr(lgb, name), f"lightgbm_tpu.{name} missing (R calls it)"
+    for name in calls_py:
+        assert (hasattr(Booster, name) or hasattr(Dataset, name)
+                or name in ("_binned",)), \
+            f"Booster/Dataset.{name} missing (R calls it)"
