@@ -21,8 +21,12 @@ Dataset <- R6::R6Class(
       }
       ref_py <- if (!is.null(reference)) reference$py else NULL
       feat <- if (is.null(colnames)) "auto" else as.list(colnames)
+      # numeric feature indices are 1-based in R, 0-based in the core
+      # (reference R-package does the same -1L)
       cat_feat <- if (is.null(categorical_feature)) "auto" else
-        as.list(categorical_feature)
+        as.list(lapply(categorical_feature, function(x) {
+          if (is.numeric(x)) as.integer(x) - 1L else x
+        }))
       self$py <- lgb$Dataset(
         data = payload,
         label = info[["label"]],
@@ -100,7 +104,10 @@ Dataset <- R6::R6Class(
     },
 
     set_categorical_feature = function(categorical_feature) {
-      self$py$set_categorical_feature(as.list(categorical_feature))
+      self$py$set_categorical_feature(as.list(
+        lapply(categorical_feature, function(x) {
+          if (is.numeric(x)) as.integer(x) - 1L else x
+        })))
       invisible(self)
     }
   )

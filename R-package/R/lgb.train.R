@@ -19,12 +19,22 @@ lgb.train <- function(params = list(), data, nrounds = 10,
                       callbacks = list(), ...) {
   lgb <- lgb.get.module()
   lgb.check.r6(data, "lgb.Dataset", "lgb.train")
+  if (length(callbacks)) {
+    stop("lgb.train: R-side callbacks are not supported by this binding; ",
+         "use the Python API for custom callbacks")
+  }
   params <- lgb.params2list(params, ...)
   if (!is.null(obj)) {
     params$objective <- obj
   }
   if (!is.null(eval)) {
     params$metric <- eval
+  }
+  if (!is.null(colnames)) {
+    data$py$set_feature_name(as.list(colnames))
+  }
+  if (!is.null(categorical_feature)) {
+    data$set_categorical_feature(categorical_feature)
   }
   params$verbose <- verbose
   valid_sets <- lapply(valids, function(v) v$py)
@@ -66,7 +76,16 @@ lgb.cv <- function(params = list(), data, nrounds = 10, nfold = 3,
                    categorical_feature = NULL,
                    early_stopping_rounds = NULL, callbacks = list(), ...) {
   lgb <- lgb.get.module()
-  lgb.check.r6(data, "lgb.Dataset", "lgb.cv")
+  if (length(callbacks)) {
+    stop("lgb.cv: R-side callbacks are not supported by this binding")
+  }
+  if (!is.null(folds)) {
+    stop("lgb.cv: custom folds are not supported by this binding")
+  }
+  if (!inherits(data, "lgb.Dataset")) {
+    # reference lgb.cv accepts a raw matrix + label/weight
+    data <- lgb.Dataset(data, info = list(label = label, weight = weight))
+  }
   params <- lgb.params2list(params, ...)
   if (!is.null(obj)) {
     params$objective <- obj

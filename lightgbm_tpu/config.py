@@ -104,6 +104,11 @@ _DEFAULTS: Dict[str, Any] = {
     "objective": "regression",
     "boosting_type": "gbdt",
     "tree_learner": "serial",
+    # serial-learner strategy: "ordered" = leaf-ordered physical layout
+    # (ops/ordered_grow.py, uint8 bins only); "cached" = original-order
+    # cached learner (ops/grow.py).  TPU-specific extension, not a
+    # reference parameter.
+    "serial_grow": "ordered",
     "seed": 0,
     "num_threads": 0,
     "metric": [],
@@ -314,6 +319,9 @@ class Config:
         v = self._values
         if v["tree_learner"] not in ("serial", "feature", "data", "voting"):
             raise ValueError(f"Unknown tree learner type {v['tree_learner']}")
+        if v["serial_grow"] not in ("ordered", "cached"):
+            raise ValueError(
+                f"Unknown serial_grow strategy {v['serial_grow']}")
         # num_machines here means mesh devices; 1 device => normalize back to
         # serial like the reference (config.cpp:161-172).
         if v["num_machines"] <= 1:

@@ -167,6 +167,16 @@ class GBDT:
                         cfg.tree_learner, ndev)
         params = self.grow_params
         bins_rm = self.train_data.bins_rm
+        if (cfg.serial_grow == "ordered"
+                and self.train_data.bins.dtype == jnp.uint8):
+            # leaf-ordered physical layout: partition cost ~ parent
+            # segment, no gathers (ops/ordered_grow.py; exact-parity
+            # tested against the unordered cached learner).  Its i32 lane
+            # packing is uint8-only; >256-bin datasets use the cached
+            # learner.
+            from ..ops.ordered_grow import grow_tree_ordered
+            return lambda *args: grow_tree_ordered(*args, params,
+                                                   bins_rm=bins_rm)
         return lambda *args: grow_tree(*args, params, bins_rm=bins_rm)
 
     def reset_config(self, config: Config) -> None:
@@ -226,6 +236,13 @@ class GBDT:
 
     def add_valid_dataset(self, valid_set: BinnedDataset) -> None:
         """GBDT::AddValidDataset (gbdt.cpp:169-199)."""
+        if not _mappers_aligned(self.train_set, valid_set):
+            # Dataset::CheckAlign: bin-space replay/scoring is only
+            # meaningful when the valid set shares the training mappers
+            # (create it with reference=train / LGBM_DatasetCreateFromX
+            # with the train handle as reference)
+            log.fatal("Cannot add validation data, since it has different "
+                      "bin mappers with training data")
         dd = _DeviceData(valid_set, self.num_class)
         # replay existing trees (continued training)
         for i, tree in enumerate(self.models):

@@ -1,0 +1,148 @@
+"""Leaf-ordered grower (ops/ordered_grow.py) must produce EXACTLY the
+same tree as the unordered cached learner (ops/grow.py SerialComm): both
+accumulate identical int32 fixed-point digit sums over identical row
+sets, so every split decision, leaf value, leaf assignment and score
+delta matches bit-for-bit."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.grow import GrowParams, grow_tree
+from lightgbm_tpu.ops.ordered_grow import grow_tree_ordered
+
+
+def _data(n=20000, f=6, seed=0, cat_feature=False):
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, 32, size=(f, n)).astype(np.uint8)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = (np.abs(rng.normal(size=n)) + 0.1).astype(np.float32)
+    w = np.ones(n, np.float32)
+    num_bin = np.full(f, 32, np.int32)
+    is_cat = np.zeros(f, bool)
+    if cat_feature:
+        is_cat[1] = True
+    feat_mask = np.ones(f, bool)
+    return (jnp.asarray(bins), jnp.asarray(num_bin), jnp.asarray(is_cat),
+            jnp.asarray(feat_mask), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.asarray(w))
+
+
+@pytest.mark.parametrize("num_leaves,cat", [(15, False), (31, True),
+                                            (7, False)])
+def test_ordered_matches_unordered(num_leaves, cat):
+    bins, num_bin, is_cat, feat_mask, g, h, w = _data(cat_feature=cat)
+    params = GrowParams(num_leaves=num_leaves, max_bin=32,
+                        min_data_in_leaf=20, min_sum_hessian_in_leaf=1.0)
+    bins_rm = jnp.asarray(np.ascontiguousarray(np.asarray(bins).T))
+    lr = jnp.float32(0.1)
+
+    t_ref, leaf_ref, delta_ref = grow_tree(bins, num_bin, is_cat, feat_mask,
+                                           g, h, w, lr, params,
+                                           bins_rm=bins_rm)
+    t_ord, leaf_ord, delta_ord = grow_tree_ordered(
+        bins, num_bin, is_cat, feat_mask, g, h, w, lr, params,
+        bins_rm=bins_rm)
+
+    assert int(t_ord.num_leaves) == int(t_ref.num_leaves)
+    for field in ("split_feature", "split_bin", "left_child", "right_child",
+                  "leaf_count", "leaf_parent", "leaf_depth"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(t_ord, field)),
+            np.asarray(getattr(t_ref, field)), err_msg=field)
+    for field in ("split_gain", "internal_value", "leaf_value"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(t_ord, field)),
+            np.asarray(getattr(t_ref, field)), rtol=1e-6, atol=1e-7,
+            err_msg=field)
+    np.testing.assert_array_equal(np.asarray(leaf_ord),
+                                  np.asarray(leaf_ref))
+    np.testing.assert_allclose(np.asarray(delta_ord),
+                               np.asarray(delta_ref), rtol=1e-6, atol=1e-7)
+
+
+def test_ordered_with_bagging_weights():
+    bins, num_bin, is_cat, feat_mask, g, h, w = _data(n=9000)
+    rng = np.random.RandomState(1)
+    w = jnp.asarray((rng.uniform(size=9000) < 0.7).astype(np.float32))
+    params = GrowParams(num_leaves=15, max_bin=32, min_data_in_leaf=20,
+                        min_sum_hessian_in_leaf=1.0)
+    bins_rm = jnp.asarray(np.ascontiguousarray(np.asarray(bins).T))
+    lr = jnp.float32(0.1)
+    t_ref, leaf_ref, _ = grow_tree(bins, num_bin, is_cat, feat_mask,
+                                   g, h, w, lr, params, bins_rm=bins_rm)
+    t_ord, leaf_ord, _ = grow_tree_ordered(bins, num_bin, is_cat, feat_mask,
+                                           g, h, w, lr, params,
+                                           bins_rm=bins_rm)
+    assert int(t_ord.num_leaves) == int(t_ref.num_leaves)
+    np.testing.assert_array_equal(np.asarray(t_ord.split_feature),
+                                  np.asarray(t_ref.split_feature))
+    np.testing.assert_array_equal(np.asarray(leaf_ord),
+                                  np.asarray(leaf_ref))
+
+
+def test_ordered_saturation_stops():
+    bins, num_bin, is_cat, feat_mask, g, h, w = _data(n=512)
+    params = GrowParams(num_leaves=31, max_bin=32, min_data_in_leaf=300,
+                        min_sum_hessian_in_leaf=1.0)
+    t, leaf, delta = grow_tree_ordered(bins, num_bin, is_cat, feat_mask,
+                                       g, h, w, jnp.float32(0.1), params)
+    assert int(t.num_leaves) == 1
+    np.testing.assert_array_equal(np.asarray(leaf), 0)
+    np.testing.assert_array_equal(np.asarray(delta), 0.0)
+
+
+def test_uint16_bins_fall_back_to_cached_learner():
+    """max_bin > 256 stores uint16 bins; the ordered grower's i32 lane
+    packing is uint8-only, so GBDT must route to the cached learner and
+    still train correctly."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(3000, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "max_bin": 500,
+                     "num_leaves": 15, "verbose": -1,
+                     "min_data_in_leaf": 20},
+                    lgb.Dataset(X, label=y, params={"max_bin": 500}),
+                    num_boost_round=5)
+    assert bst.num_trees() == 5
+    p = bst.predict(X[:50])
+    assert np.isfinite(p).all()
+
+
+def test_serial_grow_config_knob():
+    """serial_grow=cached selects the original-order learner; results
+    match the ordered default exactly."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(1)
+    X = rng.normal(size=(3000, 4))
+    y = (X[:, 0] + 0.2 * X[:, 1] > 0).astype(np.float64)
+    preds = []
+    for strategy in ("ordered", "cached"):
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbose": -1, "min_data_in_leaf": 20,
+                         "serial_grow": strategy},
+                        lgb.Dataset(X, label=y), num_boost_round=5)
+        preds.append(bst.predict(X[:200], raw_score=True))
+    np.testing.assert_allclose(preds[0], preds[1], rtol=1e-6, atol=1e-7)
+
+
+def test_misaligned_valid_set_rejected():
+    """AddValidData with independently binned data must fatal
+    (Dataset::CheckAlign semantics), not silently mis-score."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    rng = np.random.RandomState(2)
+    X = rng.normal(size=(500, 3))
+    y = (X[:, 0] > 0).astype(np.float64)
+    X2 = rng.normal(size=(300, 3)) * 5.0      # different value range
+    ds = BinnedDataset.from_matrix(X, y, max_bin=32, min_data_in_leaf=10)
+    bad = BinnedDataset.from_matrix(X2, y[:300], max_bin=32,
+                                    min_data_in_leaf=10)
+    good = ds.create_valid(X2, y[:300])
+    cfg = Config({"objective": "binary", "num_leaves": 7, "metric": "auc"})
+    b = GBDT(cfg, ds)
+    b.add_valid_dataset(good)                 # aligned: fine
+    with pytest.raises(Exception):
+        b.add_valid_dataset(bad)
