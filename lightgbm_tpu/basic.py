@@ -89,15 +89,56 @@ class Dataset:
             ref = None
 
         data = self.data
+        streamed = None
         if isinstance(data, str):
-            label, X, header = parse_file(
-                data, has_header=bool(self.params.get("has_header", False)),
-                label_idx=int(self.params.get("label_column", 0)))
-            if self.label is None:
-                self.label = label
-            if header and self.feature_name == "auto":
-                self.feature_name = header
-            data = X
+            cfg_probe = Config({**self.params, "task": "train"})
+            if cfg_probe.use_two_round_loading:
+                # streaming loader: never materializes the float matrix
+                # (dataset_loader.cpp:191-206 use_two_round semantics).
+                # Categorical features must resolve to indices BEFORE the
+                # load; name-based entries need header names.
+                cat = self.categorical_feature
+                cat_idx_stream: List[int] = []
+                if cat not in ("auto", None):
+                    names = (None if self.feature_name == "auto"
+                             else list(self.feature_name))
+                    if names is None and cfg_probe.has_header:
+                        from .io.streaming import read_header_names
+                        names = read_header_names(
+                            data, int(self.params.get("label_column", 0)
+                                      or 0))
+                    for c in cat:
+                        if isinstance(c, str):
+                            if names is None or c not in names:
+                                raise LightGBMError(
+                                    f"Unknown categorical feature name "
+                                    f"{c!r} (two-round loading resolves "
+                                    f"names from the file header)")
+                            cat_idx_stream.append(names.index(c))
+                        else:
+                            cat_idx_stream.append(int(c))
+                from .io.streaming import load_file_two_round
+                streamed = load_file_two_round(
+                    data, has_header=cfg_probe.has_header,
+                    label_idx=int(self.params.get("label_column", 0) or 0),
+                    max_bin=int(self.params.get("max_bin", self.max_bin)),
+                    min_data_in_bin=cfg_probe.min_data_in_bin,
+                    min_data_in_leaf=cfg_probe.min_data_in_leaf,
+                    bin_construct_sample_cnt=cfg_probe.bin_construct_sample_cnt,
+                    categorical_features=cat_idx_stream,
+                    data_random_seed=cfg_probe.data_random_seed,
+                    reference=ref)
+                data = None
+            else:
+                label, X, header = parse_file(
+                    data,
+                    has_header=bool(self.params.get("has_header", False)),
+                    label_idx=int(self.params.get("label_column", 0)))
+                if self.label is None:
+                    self.label = label
+                if header and self.feature_name == "auto":
+                    self.feature_name = header
+                data = X
         else:
             data, self.feature_name, self.categorical_feature = \
                 _data_from_pandas(data, self.feature_name,
@@ -120,7 +161,9 @@ class Dataset:
                 else:
                     cat_idx.append(int(c))
 
-        if self.used_indices is not None:
+        if streamed is not None:
+            self._binned = streamed
+        elif self.used_indices is not None:
             # Subset of a constructed reference (reference subset(),
             # basic.py:820-837)
             base = self.reference.construct()._binned
@@ -148,7 +191,8 @@ class Dataset:
             md.set_query(np.asarray(self.group))
         if self.init_score is not None:
             md.set_init_score(np.asarray(self.init_score))
-        if isinstance(self.data, str):
+        if isinstance(self.data, str) and streamed is None:
+            # the streaming loader already side-loaded .weight/.query/.init
             md.load_side_files(self.data)
         if self._predictor is not None:
             # continued training: init scores = prior model's raw predictions

@@ -102,6 +102,41 @@ class Metadata:
         return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
 
 
+def build_mappers_from_sample(sample: np.ndarray, num_data: int, *,
+                              max_bin: int, min_data_in_bin: int,
+                              min_data_in_leaf: int,
+                              categorical_features=frozenset(),
+                              ignore_features=frozenset(),
+                              predefined_mappers=None):
+    """Per-REAL-feature BinMapper list (None for ignored features) from a
+    row sample — the FindBin stage of dataset_loader.cpp:656-722, shared
+    by in-memory, two-round/streaming, and distributed loading so all
+    three produce identical mappers from identical samples.
+
+    The trivial-feature filter count is scaled to the sample
+    (dataset_loader.cpp:490,704): 0.95 * min_data_in_leaf / num_data *
+    sample_cnt."""
+    total_sample_cnt = sample.shape[0]
+    filter_cnt = int(0.95 * min_data_in_leaf / max(1, num_data)
+                     * total_sample_cnt)
+    out: List[Optional[BinMapper]] = []
+    for f in range(sample.shape[1]):
+        if f in ignore_features:
+            out.append(None)
+            continue
+        if predefined_mappers is not None and \
+                predefined_mappers[f] is not None:
+            out.append(predefined_mappers[f])
+            continue
+        col = sample[:, f]
+        nonzero = col[col != 0.0]
+        out.append(BinMapper().find_bin(
+            nonzero, total_sample_cnt, max_bin, min_data_in_bin,
+            filter_cnt,
+            CATEGORICAL if f in categorical_features else NUMERICAL))
+    return out
+
+
 class BinnedDataset:
     """Column-binned training matrix.
 
@@ -167,27 +202,17 @@ class BinnedDataset:
             sample = data
         total_sample_cnt = sample.shape[0]
 
-        # Trivial-feature filter count is scaled to the sample
-        # (dataset_loader.cpp:490,704): 0.95 * min_data_in_leaf / num_data
-        # * sample_cnt.
-        filter_cnt = int(0.95 * min_data_in_leaf / max(1, num_data) * total_sample_cnt)
-
+        per_real = build_mappers_from_sample(
+            sample, num_data, max_bin=max_bin,
+            min_data_in_bin=min_data_in_bin,
+            min_data_in_leaf=min_data_in_leaf,
+            categorical_features=cat, ignore_features=ignored,
+            predefined_mappers=predefined_mappers)
         self.real_to_inner = np.full(num_features, -1, dtype=np.int64)
         mappers: List[BinMapper] = []
         used: List[int] = []
-        for f in range(num_features):
-            if f in ignored:
-                continue
-            if predefined_mappers is not None and predefined_mappers[f] is not None:
-                mapper = predefined_mappers[f]
-            else:
-                col = sample[:, f]
-                nonzero = col[col != 0.0]
-                mapper = BinMapper().find_bin(
-                    nonzero, total_sample_cnt, max_bin, min_data_in_bin,
-                    filter_cnt,
-                    CATEGORICAL if f in cat else NUMERICAL)
-            if mapper.is_trivial:
+        for f, mapper in enumerate(per_real):
+            if mapper is None or mapper.is_trivial:
                 continue
             self.real_to_inner[f] = len(used)
             used.append(f)

@@ -1,0 +1,211 @@
+"""Two-round (streaming) file loading.
+
+Reference: dataset_loader.cpp:191-206 + config use_two_round_loading
+(config.h:100, io_config two_round aliases).  The one-round path
+materializes the full [N, F] float64 matrix before binning (~2.2 GB for
+Higgs-10M) — the exact "single-host materialization wall" called out in
+SURVEY §5.  Two-round loading never holds more than one text chunk and
+the sample in memory:
+
+  round 1a: stream the file once — count rows (and, for LibSVM, the max
+            feature index, which late rows may raise);
+  round 1b: stream again collecting ONLY the sampled lines (the sample
+            indices are drawn exactly like the in-memory path:
+            global row count + same seed -> the resulting mappers are
+            bit-identical to BinnedDataset.from_matrix on the same file);
+  round 2:  stream in chunks, parse each chunk, bin it straight into the
+            preallocated uint8/uint16 bin matrix.
+
+Peak memory: bins [used_F, N] (1 byte/cell) + chunk + sample, instead of
+N * F * 8 bytes of floats.
+
+Chunks are parsed with the Python parser; the one-round path prefers the
+native C++ loader whose fast atof can differ from float() by ~1 ulp, so
+two-round and one-round bins may disagree on values that sit exactly on
+a bin boundary (observed < 0.1% of cells on the reference examples;
+mappers built from the same parser are bit-identical —
+tests/test_streaming.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import log
+from .binning import BinMapper
+from .dataset import BinnedDataset, Metadata, build_mappers_from_sample
+from .parser import _parse_delimited, _parse_libsvm, detect_format
+
+
+def _data_lines(path: str, skip_header: bool):
+    """Yield raw data lines (newline-stripped), skipping the header."""
+    with open(path, "r") as fh:
+        if skip_header:
+            fh.readline()
+        for line in fh:
+            line = line.rstrip("\r\n")
+            if line.strip():
+                yield line
+
+
+def _probe_format(path: str, has_header: bool) -> str:
+    probe: List[str] = []
+    for line in _data_lines(path, has_header):
+        probe.append(line)
+        if len(probe) >= 32:
+            break
+    return detect_format(probe)
+
+
+def read_header_names(path: str, label_idx: int = 0) -> List[str]:
+    """Feature names from the header line (label column removed)."""
+    fmt = _probe_format(path, True)
+    with open(path, "r") as fh:
+        first = fh.readline().rstrip("\r\n")
+    delim = {"csv": ",", "tsv": "\t"}.get(fmt, "\t")
+    header = first.split(delim)
+    if label_idx >= 0 and fmt != "libsvm" and len(header) > label_idx:
+        header = header[:label_idx] + header[label_idx + 1:]
+    return header
+
+
+def _parse_chunk(lines: List[str], fmt: str, label_idx: int,
+                 num_features: Optional[int]):
+    if fmt == "libsvm":
+        return _parse_libsvm(lines, num_features)
+    delim = "," if fmt == "csv" else "\t"
+    return _parse_delimited(lines, delim, label_idx)
+
+
+def load_file_two_round(path: str, *, has_header: bool = False,
+                        label_idx: int = 0, max_bin: int = 255,
+                        min_data_in_bin: int = 5, min_data_in_leaf: int = 100,
+                        bin_construct_sample_cnt: int = 200000,
+                        categorical_features: Sequence[int] = (),
+                        ignore_features: Sequence[int] = (),
+                        data_random_seed: int = 1,
+                        reference: Optional[BinnedDataset] = None,
+                        chunk_rows: int = 262144) -> BinnedDataset:
+    """Stream-load ``path`` into a BinnedDataset without materializing the
+    float matrix.  Identical output to parse_file + from_matrix (asserted
+    by tests/test_streaming.py); with ``reference`` the file is binned
+    with the reference's mappers (validation alignment)."""
+    fmt = _probe_format(path, has_header)
+
+    # round 1a: row count (+ LibSVM feature count; skipped when the
+    # reference already fixes the feature space)
+    num_data = 0
+    max_col = -1
+    scan_cols = fmt == "libsvm" and reference is None
+    for line in _data_lines(path, has_header):
+        num_data += 1
+        if scan_cols:
+            parts = line.split()
+            for tok in parts[1:] if ":" not in parts[0] else parts:
+                max_col = max(max_col, int(tok.split(":", 1)[0]))
+    if num_data == 0:
+        log.fatal("Two-round loader: %s contains no data rows", path)
+
+    if reference is not None:
+        # mappers come from the reference: no sampling pass needed
+        sample = None
+        F = reference.num_total_features
+    else:
+        # round 1b: the sample — same indices as the in-memory path
+        rng = np.random.RandomState(data_random_seed)
+        if num_data > bin_construct_sample_cnt:
+            sample_idx = np.sort(rng.choice(num_data,
+                                            bin_construct_sample_cnt,
+                                            replace=False))
+        else:
+            sample_idx = np.arange(num_data)
+        wanted = np.zeros(num_data, bool)
+        wanted[sample_idx] = True
+        sample_lines = [ln for i, ln in
+                        enumerate(_data_lines(path, has_header))
+                        if wanted[i]]
+        num_features = (max_col + 1) if fmt == "libsvm" else None
+        _, sample = _parse_chunk(sample_lines, fmt, label_idx, num_features)
+        F = sample.shape[1]
+
+    ds = BinnedDataset()
+    ds.num_total_features = F
+    ds.max_bin = max_bin
+    ds.label_idx = label_idx
+    ds.feature_names = [f"Column_{i}" for i in range(F)]
+    if has_header:
+        with open(path, "r") as fh:
+            first = fh.readline().rstrip("\r\n")
+        delim = {"csv": ",", "tsv": "\t"}.get(fmt, "\t")
+        header = first.split(delim)
+        if label_idx >= 0 and fmt != "libsvm" and len(header) > label_idx:
+            header = header[:label_idx] + header[label_idx + 1:]
+        if len(header) == F:
+            ds.feature_names = header
+
+    if reference is not None:
+        ds.num_total_features = reference.num_total_features
+        ds.feature_names = list(reference.feature_names)
+        ds.used_feature_map = list(reference.used_feature_map)
+        ds.real_to_inner = reference.real_to_inner.copy()
+        ds.mappers = reference.mappers
+    else:
+        per_real = build_mappers_from_sample(
+            sample, num_data, max_bin=max_bin,
+            min_data_in_bin=min_data_in_bin,
+            min_data_in_leaf=min_data_in_leaf,
+            categorical_features=set(int(c) for c in categorical_features),
+            ignore_features=set(int(c) for c in ignore_features))
+        ds.real_to_inner = np.full(F, -1, dtype=np.int64)
+        used: List[int] = []
+        mappers: List[BinMapper] = []
+        for f, m in enumerate(per_real):
+            if m is None or m.is_trivial:
+                continue
+            ds.real_to_inner[f] = len(used)
+            used.append(f)
+            mappers.append(m)
+        ds.used_feature_map = used
+        ds.mappers = mappers
+        if not used:
+            log.warning("All features are trivial; dataset has no usable "
+                        "feature")
+
+    dtype = np.uint8 if max([m.num_bin for m in ds.mappers] or [1]) <= 256 \
+        else np.uint16
+    ds.bins = np.zeros((len(ds.used_feature_map), num_data), dtype=dtype)
+    labels = np.zeros(num_data, np.float32)
+
+    # round 2: chunked parse + bin
+    off = 0
+    buf: List[str] = []
+    nf = ds.num_total_features if fmt == "libsvm" else None
+
+    def flush():
+        nonlocal off, buf
+        if not buf:
+            return
+        lab, feats = _parse_chunk(buf, fmt, label_idx, nf)
+        n = feats.shape[0]
+        for inner, f in enumerate(ds.used_feature_map):
+            col = feats[:, f] if f < feats.shape[1] else \
+                np.zeros(n, np.float64)
+            ds.bins[inner, off:off + n] = \
+                ds.mappers[inner].value_to_bin(col).astype(dtype)
+        labels[off:off + n] = lab.astype(np.float32)
+        off += n
+        buf = []
+
+    for line in _data_lines(path, has_header):
+        buf.append(line)
+        if len(buf) >= chunk_rows:
+            flush()
+    flush()
+    assert off == num_data, (off, num_data)
+
+    ds.metadata = Metadata(num_data)
+    ds.metadata.set_label(labels)
+    ds.metadata.load_side_files(path)
+    return ds
