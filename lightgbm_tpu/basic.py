@@ -148,10 +148,10 @@ class Dataset:
         feature_name = (None if self.feature_name == "auto"
                         else list(self.feature_name))
         cat = self.categorical_feature
-        if cat == "auto" or cat is None:
-            cat_idx: List[int] = []
-        else:
-            cat_idx = []
+        cat_idx: List[int] = []
+        if streamed is None and cat not in ("auto", None):
+            # (the streamed branch resolved its categorical indices from
+            # the file header before loading)
             for c in cat:
                 if isinstance(c, str):
                     if feature_name is None or c not in feature_name:
@@ -162,6 +162,9 @@ class Dataset:
                     cat_idx.append(int(c))
 
         if streamed is not None:
+            if feature_name is not None and \
+                    len(feature_name) == streamed.num_total_features:
+                streamed.feature_names = list(feature_name)
             self._binned = streamed
         elif self.used_indices is not None:
             # Subset of a constructed reference (reference subset(),
@@ -197,9 +200,32 @@ class Dataset:
         if self._predictor is not None:
             # continued training: init scores = prior model's raw predictions
             # (reference _set_predictor flow, dataset_loader.cpp:10)
-            raw = np.asarray(self._predictor.predict(self.data if data is None
-                                                     else data,
-                                                     raw_score=True))
+            if streamed is not None:
+                # chunked predict: never materialize the full float matrix
+                from .io.streaming import _data_lines, _parse_chunk, \
+                    _probe_format
+                path = self.data
+                has_h = bool(self.params.get("has_header", False))
+                fmt = _probe_format(path, has_h)
+                nf = streamed.num_total_features if fmt == "libsvm" else None
+                lbl_idx = int(self.params.get("label_column", 0) or 0)
+                chunks = []
+                buf: List[str] = []
+                for line in _data_lines(path, has_h):
+                    buf.append(line)
+                    if len(buf) >= 262144:
+                        _, Xc = _parse_chunk(buf, fmt, lbl_idx, nf)
+                        chunks.append(np.asarray(
+                            self._predictor.predict(Xc, raw_score=True)))
+                        buf = []
+                if buf:
+                    _, Xc = _parse_chunk(buf, fmt, lbl_idx, nf)
+                    chunks.append(np.asarray(
+                        self._predictor.predict(Xc, raw_score=True)))
+                raw = np.concatenate(chunks, axis=0)
+            else:
+                raw = np.asarray(self._predictor.predict(
+                    self.data if data is None else data, raw_score=True))
             # class-major flatten for multiclass (score[k*num_data + i])
             md.set_init_score(raw.reshape(-1, order="F"))
         if self.free_raw_data:

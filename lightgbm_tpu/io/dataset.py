@@ -107,7 +107,8 @@ def build_mappers_from_sample(sample: np.ndarray, num_data: int, *,
                               min_data_in_leaf: int,
                               categorical_features=frozenset(),
                               ignore_features=frozenset(),
-                              predefined_mappers=None):
+                              predefined_mappers=None,
+                              feature_indices=None):
     """Per-REAL-feature BinMapper list (None for ignored features) from a
     row sample — the FindBin stage of dataset_loader.cpp:656-722, shared
     by in-memory, two-round/streaming, and distributed loading so all
@@ -115,25 +116,28 @@ def build_mappers_from_sample(sample: np.ndarray, num_data: int, *,
 
     The trivial-feature filter count is scaled to the sample
     (dataset_loader.cpp:490,704): 0.95 * min_data_in_leaf / num_data *
-    sample_cnt."""
+    sample_cnt.  ``feature_indices`` restricts the work to a subset of
+    features (the feature-sharded distributed FindBin); unlisted features
+    get None."""
     total_sample_cnt = sample.shape[0]
     filter_cnt = int(0.95 * min_data_in_leaf / max(1, num_data)
                      * total_sample_cnt)
-    out: List[Optional[BinMapper]] = []
-    for f in range(sample.shape[1]):
+    todo = range(sample.shape[1]) if feature_indices is None \
+        else feature_indices
+    out: List[Optional[BinMapper]] = [None] * sample.shape[1]
+    for f in todo:
         if f in ignore_features:
-            out.append(None)
             continue
         if predefined_mappers is not None and \
                 predefined_mappers[f] is not None:
-            out.append(predefined_mappers[f])
+            out[f] = predefined_mappers[f]
             continue
         col = sample[:, f]
         nonzero = col[col != 0.0]
-        out.append(BinMapper().find_bin(
+        out[f] = BinMapper().find_bin(
             nonzero, total_sample_cnt, max_bin, min_data_in_bin,
             filter_cnt,
-            CATEGORICAL if f in categorical_features else NUMERICAL))
+            CATEGORICAL if f in categorical_features else NUMERICAL)
     return out
 
 

@@ -136,12 +136,7 @@ def load_file_two_round(path: str, *, has_header: bool = False,
     ds.label_idx = label_idx
     ds.feature_names = [f"Column_{i}" for i in range(F)]
     if has_header:
-        with open(path, "r") as fh:
-            first = fh.readline().rstrip("\r\n")
-        delim = {"csv": ",", "tsv": "\t"}.get(fmt, "\t")
-        header = first.split(delim)
-        if label_idx >= 0 and fmt != "libsvm" and len(header) > label_idx:
-            header = header[:label_idx] + header[label_idx + 1:]
+        header = read_header_names(path, label_idx)
         if len(header) == F:
             ds.feature_names = header
 

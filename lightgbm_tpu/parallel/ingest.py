@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..io.binning import CATEGORICAL, NUMERICAL, BinMapper
+from ..io.binning import NUMERICAL, BinMapper
 from ..io.dataset import BinnedDataset, Metadata
 from ..utils import log
 
@@ -205,9 +205,6 @@ def distributed_find_bin(mesh: Mesh, axis: str,
     sample_idx = global_sample_indices(num_data, bin_construct_sample_cnt,
                                        data_random_seed)
     S = len(sample_idx)
-    total_sample_cnt = S
-    filter_cnt = int(0.95 * min_data_in_leaf / max(1, num_data)
-                     * total_sample_cnt)
 
     # 1. each shard fills its owned sampled rows; psum reconstitutes
     contrib = np.zeros((k, S, F), np.float64)
@@ -220,16 +217,18 @@ def distributed_find_bin(mesh: Mesh, axis: str,
     sample_global = exchange(contrib)
 
     # 2. feature-sharded FindBin + 3. encoded-mapper psum
+    from ..io.dataset import build_mappers_from_sample
     w = mapper_width(max_bin)
     enc = np.zeros((k, F, w), np.float64)
     for r in range(k):
+        per_real = build_mappers_from_sample(
+            sample_global, num_data, max_bin=max_bin,
+            min_data_in_bin=min_data_in_bin,
+            min_data_in_leaf=min_data_in_leaf,
+            categorical_features=cat,
+            feature_indices=range(r, F, k))
         for f in range(r, F, k):
-            col = sample_global[:, f]
-            nonzero = col[col != 0.0]
-            m = BinMapper().find_bin(
-                nonzero, total_sample_cnt, max_bin, min_data_in_bin,
-                filter_cnt, CATEGORICAL if f in cat else NUMERICAL)
-            enc[r, f] = encode_mapper(m, max_bin)
+            enc[r, f] = encode_mapper(per_real[f], max_bin)
     enc_global = exchange(enc)
     return [decode_mapper(enc_global[f]) for f in range(F)]
 
