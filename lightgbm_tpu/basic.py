@@ -90,8 +90,36 @@ class Dataset:
 
         data = self.data
         streamed = None
+        file_roles = None
+        file_label_idx = 0
         if isinstance(data, str):
             cfg_probe = Config({**self.params, "task": "train"})
+            # In-data column roles (dataset_loader.cpp SetHeader, :22-157):
+            # label against the full header, everything else against the
+            # label-removed names.
+            from .io.column_roles import resolve_label_idx, resolve_roles
+            full_names = None
+            if cfg_probe.has_header:
+                from .io.streaming import read_full_header_names
+                full_names, _ = read_full_header_names(data)
+            file_label_idx = resolve_label_idx(
+                str(cfg_probe.label_column or ""), full_names)
+            feat_names_for_roles = None
+            if full_names is not None:
+                feat_names_for_roles = (
+                    full_names[:file_label_idx]
+                    + full_names[file_label_idx + 1:])
+            elif self.feature_name != "auto" and self.feature_name:
+                feat_names_for_roles = list(self.feature_name)
+            if (cfg_probe.weight_column or cfg_probe.group_column
+                    or cfg_probe.ignore_column
+                    or cfg_probe.categorical_column):
+                file_roles = resolve_roles(
+                    str(cfg_probe.weight_column or ""),
+                    str(cfg_probe.group_column or ""),
+                    str(cfg_probe.ignore_column or ""),
+                    str(cfg_probe.categorical_column or ""),
+                    feature_names=feat_names_for_roles)
             if cfg_probe.use_two_round_loading:
                 # streaming loader: never materializes the float matrix
                 # (dataset_loader.cpp:191-206 use_two_round semantics).
@@ -104,9 +132,7 @@ class Dataset:
                              else list(self.feature_name))
                     if names is None and cfg_probe.has_header:
                         from .io.streaming import read_header_names
-                        names = read_header_names(
-                            data, int(self.params.get("label_column", 0)
-                                      or 0))
+                        names = read_header_names(data, file_label_idx)
                     for c in cat:
                         if isinstance(c, str):
                             if names is None or c not in names:
@@ -118,22 +144,31 @@ class Dataset:
                         else:
                             cat_idx_stream.append(int(c))
                 from .io.streaming import load_file_two_round
+                if file_roles is not None:
+                    cat_idx_stream = sorted(set(cat_idx_stream)
+                                            | file_roles.categorical)
                 streamed = load_file_two_round(
                     data, has_header=cfg_probe.has_header,
-                    label_idx=int(self.params.get("label_column", 0) or 0),
+                    label_idx=file_label_idx,
                     max_bin=int(self.params.get("max_bin", self.max_bin)),
                     min_data_in_bin=cfg_probe.min_data_in_bin,
                     min_data_in_leaf=cfg_probe.min_data_in_leaf,
                     bin_construct_sample_cnt=cfg_probe.bin_construct_sample_cnt,
                     categorical_features=cat_idx_stream,
+                    ignore_features=(file_roles.ignore
+                                     if file_roles is not None else ()),
+                    weight_idx=(file_roles.weight_idx
+                                if file_roles is not None else -1),
+                    group_idx=(file_roles.group_idx
+                               if file_roles is not None else -1),
                     data_random_seed=cfg_probe.data_random_seed,
                     reference=ref)
                 data = None
             else:
                 label, X, header = parse_file(
                     data,
-                    has_header=bool(self.params.get("has_header", False)),
-                    label_idx=int(self.params.get("label_column", 0)))
+                    has_header=cfg_probe.has_header,
+                    label_idx=file_label_idx)
                 if self.label is None:
                     self.label = label
                 if header and self.feature_name == "auto":
@@ -176,6 +211,8 @@ class Dataset:
         else:
             cfg = Config({**self.params, "max_bin": self.max_bin,
                           "task": "train"})
+            if file_roles is not None:
+                cat_idx = sorted(set(cat_idx) | file_roles.categorical)
             self._binned = BinnedDataset.from_matrix(
                 data, self.label,
                 max_bin=int(self.params.get("max_bin", self.max_bin)),
@@ -183,6 +220,8 @@ class Dataset:
                 min_data_in_bin=cfg.min_data_in_bin,
                 bin_construct_sample_cnt=cfg.bin_construct_sample_cnt,
                 categorical_features=cat_idx,
+                ignore_features=(file_roles.ignore
+                                 if file_roles is not None else ()),
                 feature_names=feature_name,
                 data_random_seed=cfg.data_random_seed)
         md = self._binned.metadata
@@ -197,6 +236,24 @@ class Dataset:
         if isinstance(self.data, str) and streamed is None:
             # the streaming loader already side-loaded .weight/.query/.init
             md.load_side_files(self.data)
+            if file_roles is not None and data is not None:
+                # in-data weight/group columns override side files
+                # (Metadata::Init re-allocates when the idx is set,
+                # dataset_loader.cpp:101-131)
+                from .io.column_roles import qid_to_query_sizes
+                from .utils import log as _log
+                for what, idx in (("weight_column", file_roles.weight_idx),
+                                  ("group_column", file_roles.group_idx)):
+                    if idx >= data.shape[1]:
+                        _log.fatal("%s index %d out of range (file has %d "
+                                   "feature columns)", what, idx,
+                                   data.shape[1])
+                if file_roles.weight_idx >= 0 and self.weight is None:
+                    md.set_weights(np.asarray(
+                        data[:, file_roles.weight_idx], np.float64))
+                if file_roles.group_idx >= 0 and self.group is None:
+                    md.set_query(qid_to_query_sizes(
+                        data[:, file_roles.group_idx]))
         if self._predictor is not None:
             # continued training: init scores = prior model's raw predictions
             # (reference _set_predictor flow, dataset_loader.cpp:10)
@@ -492,26 +549,56 @@ class Booster:
         }
 
     # -- prediction ------------------------------------------------------
+    _PREDICT_CHUNK_ROWS = 1 << 16
+
     def predict(self, data, num_iteration=-1, raw_score=False,
                 pred_leaf=False, data_has_header=False, is_reshape=True):
-        """Batch prediction (reference predict, basic.py:1560)."""
+        """Batch prediction (reference predict, basic.py:1560).
+
+        File inputs stream through parse -> predict in chunks of
+        _PREDICT_CHUNK_ROWS rows, so peak memory is O(chunk + result) —
+        the reference Predictor's pipelined chunk loop
+        (src/application/predictor.hpp:81-129)."""
+        b = self._booster
         if isinstance(data, str):
-            _, X, _ = parse_file(data, has_header=data_has_header,
-                                 label_idx=self._booster.label_idx)
+            from .io.parser import parse_file_chunks
+            parts = []
+            for _, X in parse_file_chunks(
+                    data, has_header=data_has_header,
+                    label_idx=b.label_idx,
+                    num_features=b.max_feature_idx + 1,
+                    chunk_rows=self._PREDICT_CHUNK_ROWS):
+                if X.size == 0:
+                    continue
+                parts.append(self._predict_array(X, num_iteration,
+                                                 raw_score, pred_leaf))
+            if not parts:
+                # empty file: predict an empty matrix so the result keeps
+                # the normal shape contract ((0, trees) for pred_leaf,
+                # (num_class, 0) otherwise)
+                parts.append(self._predict_array(
+                    np.zeros((0, b.max_feature_idx + 1)),
+                    num_iteration, raw_score, pred_leaf))
+            out = np.concatenate(parts, axis=-1 if not pred_leaf else 0)
         else:
             data, _, _ = _data_from_pandas(data, "auto", "auto")
             X = _to_dense(data)
-        b = self._booster
+            out = self._predict_array(X, num_iteration, raw_score, pred_leaf)
         if pred_leaf:
-            return b.predict_leaf_index(X, num_iteration)
-        out = (b.predict_raw(X, num_iteration) if raw_score
-               else b.predict(X, num_iteration))
-        out = np.asarray(out)
+            return out
         if out.shape[0] == 1:
             return out[0]
         if is_reshape:
             return out.T                      # [n, num_class]
         return out.reshape(-1)
+
+    def _predict_array(self, X, num_iteration, raw_score, pred_leaf):
+        b = self._booster
+        if pred_leaf:
+            return b.predict_leaf_index(X, num_iteration)
+        out = (b.predict_raw(X, num_iteration) if raw_score
+               else b.predict(X, num_iteration))
+        return np.asarray(out)
 
     # -- introspection ---------------------------------------------------
     def feature_name(self) -> List[str]:

@@ -146,6 +146,12 @@ class _CDataset:
 
     def push_rows(self, rows: np.ndarray, start_row: int) -> None:
         ds = self.binned
+        if rows.shape[1] < ds.num_total_features:
+            # a CSR chunk can be narrower than the dataset (trailing
+            # all-zero columns absent); the reference treats the missing
+            # columns as 0.0
+            rows = np.pad(rows,
+                          ((0, 0), (0, ds.num_total_features - rows.shape[1])))
         for inner, f in enumerate(ds.used_feature_map):
             ds.bins[inner, start_row:start_row + rows.shape[0]] = \
                 ds.mappers[inner].value_to_bin(rows[:, f]).astype(ds.bins.dtype)

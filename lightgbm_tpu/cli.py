@@ -69,6 +69,7 @@ def run_predict(config: Config, params: Dict[str, str]) -> None:
     booster = Booster(params=dict(params), model_file=config.input_model)
     start = time.time()
     out = booster.predict(config.data,
+                          num_iteration=config.num_iteration_predict,
                           raw_score=config.is_predict_raw_score,
                           pred_leaf=config.is_predict_leaf_index,
                           data_has_header=config.has_header)

@@ -105,7 +105,8 @@ _DEFAULTS: Dict[str, Any] = {
     "boosting_type": "gbdt",
     "tree_learner": "serial",
     # serial-learner strategy: "ordered" = leaf-ordered physical layout
-    # (ops/ordered_grow.py, uint8 bins only); "cached" = original-order
+    # (ops/ordered_grow.py, uint8 bins; >256-bin datasets fall back to
+    # the cached learner with a log line); "cached" = original-order
     # cached learner (ops/grow.py).  TPU-specific extension, not a
     # reference parameter.
     "serial_grow": "ordered",

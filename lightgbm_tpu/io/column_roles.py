@@ -1,0 +1,125 @@
+"""In-data column role resolution: label / weight / group / ignore /
+categorical columns specified by index or ``name:`` prefix.
+
+Behavioral model: DatasetLoader::SetHeader
+(/root/reference/src/io/dataset_loader.cpp:22-157):
+
+  * ``label_column`` resolves against the FULL header (all columns);
+    default 0.
+  * the label name is then erased, and every other role resolves in the
+    LABEL-REMOVED column space (so ``ignore_column=0`` is the first
+    non-label column — reference name2idx is built after the erase).
+  * ``weight_column`` / ``group_column`` name single columns; both are
+    added to the ignore set (their values feed Metadata, not features).
+  * ``ignore_column`` / ``categorical_column`` are comma-separated lists.
+  * ``name:`` entries require a header; a missing name is fatal.  Bare
+    entries must parse as integers (AtoiAndCheck), else fatal.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence, Set
+
+from ..utils import log
+
+_NAME_PREFIX = "name:"
+
+
+class ColumnRoles(NamedTuple):
+    """Resolved roles, all in LABEL-REMOVED (feature-space) indices."""
+    weight_idx: int         # -1 = none
+    group_idx: int          # -1 = none
+    ignore: Set[int]        # includes weight/group columns
+    categorical: Set[int]
+
+
+def _to_int(token: str, what: str) -> int:
+    try:
+        return int(token)
+    except ValueError:
+        log.fatal("%s is not a number, if you want to use a column name, "
+                  "please add the prefix \"name:\" to the column name",
+                  what)
+        raise
+
+
+def _one(spec: str, name2idx: Optional[dict], what: str) -> int:
+    if spec.startswith(_NAME_PREFIX):
+        name = spec[len(_NAME_PREFIX):]
+        if name2idx is None or name not in name2idx:
+            log.fatal("Could not find %s column %s in data file", what, name)
+        return name2idx[name]
+    return _to_int(spec, what)
+
+
+def _many(spec: str, name2idx: Optional[dict], what: str) -> Set[int]:
+    out: Set[int] = set()
+    if spec.startswith(_NAME_PREFIX):
+        for name in spec[len(_NAME_PREFIX):].split(","):
+            if name2idx is None or name not in name2idx:
+                log.fatal("Could not find %s column %s in data file",
+                          what, name)
+            out.add(name2idx[name])
+    else:
+        for token in spec.split(","):
+            if token:
+                out.add(_to_int(token, what))
+    return out
+
+
+def resolve_label_idx(label_column: str,
+                      full_names: Optional[Sequence[str]]) -> int:
+    """Label column in FULL column space (dataset_loader.cpp:35-59)."""
+    if not label_column:
+        return 0
+    if label_column.startswith(_NAME_PREFIX):
+        name = label_column[len(_NAME_PREFIX):]
+        if full_names:
+            for i, n in enumerate(full_names):
+                if n == name:
+                    log.info("Using column %s as label", name)
+                    return i
+        log.fatal("Could not find label column %s in data file or data "
+                  "file doesn't contain header", name)
+    return _to_int(label_column, "label_column")
+
+
+def resolve_roles(weight_column: str = "", group_column: str = "",
+                  ignore_column: str = "", categorical_column: str = "",
+                  feature_names: Optional[Sequence[str]] = None
+                  ) -> ColumnRoles:
+    """Resolve the non-label roles against LABEL-REMOVED feature names
+    (dataset_loader.cpp:61-157)."""
+    name2idx = ({n: i for i, n in enumerate(feature_names)}
+                if feature_names else None)
+    ignore: Set[int] = set()
+    if ignore_column:
+        ignore |= _many(ignore_column, name2idx, "ignore_column")
+    weight_idx = -1
+    if weight_column:
+        weight_idx = _one(weight_column, name2idx, "weight")
+        log.info("Using column %s as weight", weight_column)
+        ignore.add(weight_idx)
+    group_idx = -1
+    if group_column:
+        group_idx = _one(group_column, name2idx, "group/query id")
+        log.info("Using column %s as group/query id", group_column)
+        ignore.add(group_idx)
+    categorical: Set[int] = set()
+    if categorical_column:
+        categorical = _many(categorical_column, name2idx,
+                            "categorical_column")
+    return ColumnRoles(weight_idx, group_idx, ignore, categorical)
+
+
+def qid_to_query_sizes(qids) -> List[int]:
+    """Consecutive-run lengths of a per-row query-id column (the
+    reference's group-column -> query boundaries conversion,
+    dataset.cpp Metadata::SetQueryId semantics)."""
+    import numpy as np
+    q = np.asarray(qids)
+    if q.size == 0:
+        return []
+    change = np.nonzero(q[1:] != q[:-1])[0] + 1
+    bounds = np.concatenate([[0], change, [q.size]])
+    return list(np.diff(bounds).astype(int))

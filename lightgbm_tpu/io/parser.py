@@ -83,6 +83,56 @@ def _parse_libsvm(lines: List[str], num_features: Optional[int] = None
     return np.asarray(labels, dtype=np.float64), feats
 
 
+def parse_file_chunks(path: str, has_header: bool = False,
+                      label_idx: int = 0,
+                      num_features: Optional[int] = None,
+                      chunk_rows: int = 1 << 16):
+    """Yield (label, features) chunks of at most ``chunk_rows`` rows.
+
+    The streaming analogue of parse_file for O(chunk)-memory prediction
+    over large files (Predictor::Predict's chunked
+    ReadAllAndProcessParallel pipeline, reference
+    src/application/predictor.hpp:81-129).  The format is detected from
+    the first chunk; LibSVM chunks are densified to ``num_features``
+    columns so chunk widths agree."""
+    with open(path, "r") as fh:
+        header_line = fh.readline() if has_header else None
+        probe: List[str] = []
+        fmt: Optional[str] = None
+        chunk: List[str] = []
+        for line in fh:
+            if fmt is None and len(probe) < 32:
+                if line.strip():
+                    probe.append(line)
+            chunk.append(line)
+            if len(chunk) >= chunk_rows:
+                if fmt is None:
+                    fmt = detect_format(probe)
+                yield _parse_chunk(chunk, fmt, label_idx, num_features)
+                chunk = []
+        if chunk:
+            if fmt is None:
+                fmt = detect_format(probe)
+            yield _parse_chunk(chunk, fmt, label_idx, num_features)
+    _ = header_line
+
+
+def _parse_chunk(lines: List[str], fmt: str, label_idx: int,
+                 num_features: Optional[int]):
+    if fmt == "libsvm":
+        label, feats = _parse_libsvm(lines, num_features)
+    else:
+        delim = "," if fmt == "csv" else "\t"
+        label, feats = _parse_delimited(lines, delim, label_idx)
+    if num_features is not None and feats.ndim == 2 \
+            and feats.shape[1] != num_features:
+        fixed = np.zeros((feats.shape[0], num_features), np.float64)
+        upto = min(num_features, feats.shape[1])
+        fixed[:, :upto] = feats[:, :upto]
+        feats = fixed
+    return label, feats
+
+
 def parse_file(path: str, has_header: bool = False, label_idx: int = 0,
                num_features: Optional[int] = None
                ) -> Tuple[np.ndarray, np.ndarray, Optional[List[str]]]:

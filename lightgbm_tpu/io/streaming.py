@@ -36,7 +36,7 @@ import numpy as np
 from ..utils import log
 from .binning import BinMapper
 from .dataset import BinnedDataset, Metadata, build_mappers_from_sample
-from .parser import _parse_delimited, _parse_libsvm, detect_format
+from .parser import _parse_chunk, detect_format  # noqa: F401 (re-export)
 
 
 def _data_lines(path: str, skip_header: bool):
@@ -59,24 +59,23 @@ def _probe_format(path: str, has_header: bool) -> str:
     return detect_format(probe)
 
 
-def read_header_names(path: str, label_idx: int = 0) -> List[str]:
-    """Feature names from the header line (label column removed)."""
+def read_full_header_names(path: str) -> Tuple[List[str], str]:
+    """(all header column names, detected format) from the first line."""
     fmt = _probe_format(path, True)
     with open(path, "r") as fh:
         first = fh.readline().rstrip("\r\n")
     delim = {"csv": ",", "tsv": "\t"}.get(fmt, "\t")
-    header = first.split(delim)
+    return first.split(delim), fmt
+
+
+def read_header_names(path: str, label_idx: int = 0) -> List[str]:
+    """Feature names from the header line (label column removed)."""
+    header, fmt = read_full_header_names(path)
     if label_idx >= 0 and fmt != "libsvm" and len(header) > label_idx:
         header = header[:label_idx] + header[label_idx + 1:]
     return header
 
 
-def _parse_chunk(lines: List[str], fmt: str, label_idx: int,
-                 num_features: Optional[int]):
-    if fmt == "libsvm":
-        return _parse_libsvm(lines, num_features)
-    delim = "," if fmt == "csv" else "\t"
-    return _parse_delimited(lines, delim, label_idx)
 
 
 def load_file_two_round(path: str, *, has_header: bool = False,
@@ -85,13 +84,18 @@ def load_file_two_round(path: str, *, has_header: bool = False,
                         bin_construct_sample_cnt: int = 200000,
                         categorical_features: Sequence[int] = (),
                         ignore_features: Sequence[int] = (),
+                        weight_idx: int = -1, group_idx: int = -1,
                         data_random_seed: int = 1,
                         reference: Optional[BinnedDataset] = None,
                         chunk_rows: int = 262144) -> BinnedDataset:
     """Stream-load ``path`` into a BinnedDataset without materializing the
     float matrix.  Identical output to parse_file + from_matrix (asserted
     by tests/test_streaming.py); with ``reference`` the file is binned
-    with the reference's mappers (validation alignment)."""
+    with the reference's mappers (validation alignment).
+
+    ``weight_idx`` / ``group_idx`` name in-data columns (feature-space
+    indices, dataset_loader.cpp SetHeader) whose values stream into
+    Metadata instead of features; callers put them in ignore_features."""
     fmt = _probe_format(path, has_header)
 
     # round 1a: row count (+ LibSVM feature count; skipped when the
@@ -172,6 +176,15 @@ def load_file_two_round(path: str, *, has_header: bool = False,
         else np.uint16
     ds.bins = np.zeros((len(ds.used_feature_map), num_data), dtype=dtype)
     labels = np.zeros(num_data, np.float32)
+    F_total = ds.num_total_features
+    if weight_idx >= F_total:
+        log.fatal("weight_column index %d out of range (file has %d "
+                  "feature columns)", weight_idx, F_total)
+    if group_idx >= F_total:
+        log.fatal("group_column index %d out of range (file has %d "
+                  "feature columns)", group_idx, F_total)
+    weights = np.zeros(num_data, np.float64) if weight_idx >= 0 else None
+    qids = np.zeros(num_data, np.float64) if group_idx >= 0 else None
 
     # round 2: chunked parse + bin
     off = 0
@@ -190,6 +203,10 @@ def load_file_two_round(path: str, *, has_header: bool = False,
             ds.bins[inner, off:off + n] = \
                 ds.mappers[inner].value_to_bin(col).astype(dtype)
         labels[off:off + n] = lab.astype(np.float32)
+        if weights is not None and weight_idx < feats.shape[1]:
+            weights[off:off + n] = feats[:, weight_idx]
+        if qids is not None and group_idx < feats.shape[1]:
+            qids[off:off + n] = feats[:, group_idx]
         off += n
         buf = []
 
@@ -203,4 +220,9 @@ def load_file_two_round(path: str, *, has_header: bool = False,
     ds.metadata = Metadata(num_data)
     ds.metadata.set_label(labels)
     ds.metadata.load_side_files(path)
+    if weights is not None:
+        ds.metadata.set_weights(weights)
+    if qids is not None:
+        from .column_roles import qid_to_query_sizes
+        ds.metadata.set_query(qid_to_query_sizes(qids))
     return ds

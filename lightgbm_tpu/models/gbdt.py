@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import Config
+from ..io.binning import CATEGORICAL
 from ..io.dataset import BinnedDataset
 from ..metric import Metric, create_metric
 from ..objective import ObjectiveFunction, create_objective
@@ -41,6 +42,21 @@ class _DeviceData:
         # (ops/leafhist.py needs rows contiguous).
         self.bins_rm = (jnp.asarray(np.ascontiguousarray(dataset.bins.T))
                         if with_row_major else None)
+        # Word-packed payload lanes for the leaf-ordered grower, shared
+        # across trees (uint8 bins only; uint16 routes to the cached
+        # learner).
+        self.bins_words = None
+        if with_row_major and self.bins_rm is not None \
+                and self.bins_rm.dtype == jnp.uint8:
+            from ..ops.ordered_grow import pack_u8_words, _size_classes
+
+            pad = _size_classes(dataset.num_data)[-1]
+
+            @jax.jit
+            def _pack_padded(rm):
+                return tuple(jnp.pad(w, (0, pad))
+                             for w in pack_u8_words(rm))
+            self.bins_words = _pack_padded(self.bins_rm)
         self.num_data = dataset.num_data
         init = np.zeros((num_models, self.num_data), np.float32)
         if dataset.metadata.init_score is not None:
@@ -122,7 +138,10 @@ class GBDT:
         self._grow_fn = self._make_grow_fn()
         # device-constant caches (avoid a host->device transfer per iter)
         self._full_feat_mask = jnp.ones(self.num_features, bool)
+        self._full_feat_masks = jnp.ones((self.num_class, self.num_features),
+                                         bool)
         self._lr_cache: Tuple[float, jax.Array] = (-1.0, jnp.float32(0))
+        self._train_step = None
 
     @staticmethod
     def _make_grow_params(cfg: Config) -> GrowParams:
@@ -168,15 +187,21 @@ class GBDT:
         params = self.grow_params
         bins_rm = self.train_data.bins_rm
         if (cfg.serial_grow == "ordered"
-                and self.train_data.bins.dtype == jnp.uint8):
+                and self.train_data.bins_words is not None):
             # leaf-ordered physical layout: partition cost ~ parent
             # segment, no gathers (ops/ordered_grow.py; exact-parity
             # tested against the unordered cached learner).  Its i32 lane
             # packing is uint8-only; >256-bin datasets use the cached
-            # learner.
+            # learner (logged so the throughput change is visible).
             from ..ops.ordered_grow import grow_tree_ordered
+            bins_words = self.train_data.bins_words
             return lambda *args: grow_tree_ordered(*args, params,
-                                                   bins_rm=bins_rm)
+                                                   bins_rm=bins_rm,
+                                                   bins_words=bins_words)
+        if cfg.serial_grow == "ordered":
+            log.info("max_bin > 256: using the cached (original-order) "
+                     "serial learner; the leaf-ordered fast path is "
+                     "uint8-only")
         return lambda *args: grow_tree(*args, params, bins_rm=bins_rm)
 
     def reset_config(self, config: Config) -> None:
@@ -200,6 +225,7 @@ class GBDT:
             # argument, not part of the compiled program).
             self.grow_params = new_params
             self._grow_fn = self._make_grow_fn()
+            self._train_step = None
         self.train_metrics = self._make_metrics(config, self.train_set)
         for vi, dd in enumerate(self.valid_data):
             self.valid_metrics[vi] = self._make_metrics(config, dd.dataset)
@@ -227,10 +253,13 @@ class GBDT:
         self.train_metrics = self._make_metrics(cfg, train_set)
         self._row_weight = jnp.ones(self.num_data, jnp.float32)
         self._full_feat_mask = jnp.ones(self.num_features, bool)
+        self._full_feat_masks = jnp.ones((self.num_class, self.num_features),
+                                         bool)
         # a fresh jit: the old one captured the previous dataset's labels
         # (objective.init state) as compile-time constants
         self._grad_fn = jax.jit(self.objective.gradients)
         self._grow_fn = self._make_grow_fn()
+        self._train_step = None
         for i, tree in enumerate(self._models):
             self._add_host_tree_to(self.train_data, tree, i % self.num_class)
 
@@ -284,9 +313,43 @@ class GBDT:
         mask[idx] = True
         return jnp.asarray(mask)
 
+    def _feature_masks_all(self) -> jax.Array:
+        """[num_class, F] per-class feature masks for the fused step (same
+        RNG draw order as per-class _feature_mask calls)."""
+        frac = self.config.feature_fraction
+        if frac >= 1.0:
+            return self._full_feat_masks
+        return jnp.stack([self._feature_mask()
+                          for _ in range(self.num_class)])
+
     # ------------------------------------------------------------------
     def _gradients(self) -> Tuple[jax.Array, jax.Array]:
         return self._grad_fn(self.train_data.score)
+
+    def _make_train_step(self):
+        """One fused jit for a full boosting iteration on the standard
+        (non-fobj) path: gradients -> per-class grow -> score update ->
+        packed host transfer vectors.  A single device dispatch per
+        iteration instead of ~5: each dispatch over the remote axon link
+        costs ~1-5 ms of submit latency, which at >10 iters/sec is a
+        first-order cost (docs/BENCH_NOTES_r03.md)."""
+        grow = self._grow_fn
+        obj_grad = self.objective.gradients
+        bins, num_bin, is_cat = (self.train_data.bins, self.num_bin,
+                                 self.is_cat)
+        num_class = self.num_class
+
+        @jax.jit
+        def step_fn(score, feat_masks, row_weight, lr):
+            grad, hess = obj_grad(score)
+            outs = []
+            for cls in range(num_class):
+                ta, _, delta = grow(bins, num_bin, is_cat, feat_masks[cls],
+                                    grad[cls], hess[cls], row_weight, lr)
+                score = score.at[cls].add(delta)
+                outs.append((pack_tree_arrays(ta), ta, delta))
+            return score, outs
+        return step_fn
 
     # -- pipelined host materialization --------------------------------
     @property
@@ -353,15 +416,7 @@ class GBDT:
             # dispatching — and clear it so a later retry trains afresh
             self._no_more_splits = False
             return True
-        with timetag.scope("GBDT::boosting") as tt:
-            if grad is None or hess is None:
-                grad, hess = self._gradients()
-            else:
-                grad = jnp.asarray(grad, jnp.float32).reshape(
-                    self.num_class, -1)
-                hess = jnp.asarray(hess, jnp.float32).reshape(
-                    self.num_class, -1)
-            tt.sync((grad, hess))
+        fused = grad is None and hess is None
         with timetag.scope("GBDT::bagging"):
             row_weight = self._bagging_mask(self.iter_)
         if self._lr_cache[0] != self.shrinkage_rate:
@@ -369,25 +424,52 @@ class GBDT:
                               jnp.float32(self.shrinkage_rate))
         lr_dev = self._lr_cache[1]
         cur = []
-        for cls in range(self.num_class):
-            feat_mask = self._feature_mask()
+        if fused:
+            # standard objective: ONE device dispatch for the whole round
+            if self._train_step is None:
+                self._train_step = self._make_train_step()
+            feat_masks = self._feature_masks_all()
             with timetag.scope("GBDT::tree") as tt:
-                tree_arrays, leaf_id, delta = self._grow_fn(
-                    self.train_data.bins, self.num_bin, self.is_cat,
-                    feat_mask, grad[cls], hess[cls], row_weight, lr_dev)
-                tt.sync(delta)
-            with timetag.scope("GBDT::train_score") as tt:
-                self.train_data.score = \
-                    self.train_data.score.at[cls].add(delta)
+                self.train_data.score, outs = self._train_step(
+                    self.train_data.score, feat_masks, row_weight, lr_dev)
                 tt.sync(self.train_data.score)
-            vdeltas = []
-            with timetag.scope("GBDT::valid_score") as tt:
-                for dd in self.valid_data:
-                    vd = self._device_tree_delta(dd, tree_arrays)
-                    dd.score = dd.score.at[cls].add(vd)
-                    vdeltas.append(vd)
-                tt.sync(vdeltas)
-            cur.append((self._pack_fn(tree_arrays), delta, vdeltas))
+            for cls, (packed, tree_arrays, delta) in enumerate(outs):
+                vdeltas = []
+                with timetag.scope("GBDT::valid_score") as tt:
+                    for dd in self.valid_data:
+                        vd = self._device_tree_delta(dd, tree_arrays)
+                        dd.score = dd.score.at[cls].add(vd)
+                        vdeltas.append(vd)
+                    tt.sync(vdeltas)
+                cur.append((packed, delta, vdeltas))
+        else:
+            # custom fobj path (engine.train(fobj=...), C API boosters):
+            # gradients arrive from the host, dispatch per class
+            with timetag.scope("GBDT::boosting") as tt:
+                grad = jnp.asarray(grad, jnp.float32).reshape(
+                    self.num_class, -1)
+                hess = jnp.asarray(hess, jnp.float32).reshape(
+                    self.num_class, -1)
+                tt.sync((grad, hess))
+            for cls in range(self.num_class):
+                feat_mask = self._feature_mask()
+                with timetag.scope("GBDT::tree") as tt:
+                    tree_arrays, leaf_id, delta = self._grow_fn(
+                        self.train_data.bins, self.num_bin, self.is_cat,
+                        feat_mask, grad[cls], hess[cls], row_weight, lr_dev)
+                    tt.sync(delta)
+                with timetag.scope("GBDT::train_score") as tt:
+                    self.train_data.score = \
+                        self.train_data.score.at[cls].add(delta)
+                    tt.sync(self.train_data.score)
+                vdeltas = []
+                with timetag.scope("GBDT::valid_score") as tt:
+                    for dd in self.valid_data:
+                        vd = self._device_tree_delta(dd, tree_arrays)
+                        dd.score = dd.score.at[cls].add(vd)
+                        vdeltas.append(vd)
+                    tt.sync(vdeltas)
+                cur.append((self._pack_fn(tree_arrays), delta, vdeltas))
         self.iter_ += 1
         shrink = self.shrinkage_rate
         if not self._pipeline:
@@ -569,12 +651,22 @@ class GBDT:
     def _predict_raw_device(self, X: np.ndarray, n_models: int) -> np.ndarray:
         ts = self.train_set
         n = X.shape[0]
-        # host walk sends NaN right (NaN <= th is False); route identically
-        # by mapping NaN to +inf before binning (last bin > any threshold)
-        X = np.where(np.isnan(X), np.inf, X)
+        # host walk sends NaN right (numerical: NaN <= th is False;
+        # categorical: int64(NaN) equals no category).  Route identically:
+        # numerical NaN -> +inf before binning (last bin > any threshold),
+        # categorical NaN -> bin -1, which equals no split's threshold bin
+        # (a real category's bin would be routed left at a split on it).
         bins_np = np.zeros((len(ts.used_feature_map), n), dtype=np.int32)
         for inner, f in enumerate(ts.used_feature_map):
-            bins_np[inner] = ts.mappers[inner].value_to_bin(X[:, f])
+            col = X[:, f]
+            isnan = np.isnan(col)
+            if ts.mappers[inner].bin_type == CATEGORICAL:
+                b = ts.mappers[inner].value_to_bin(
+                    np.where(isnan, 0.0, col))
+                bins_np[inner] = np.where(isnan, -1, b)
+            else:
+                bins_np[inner] = ts.mappers[inner].value_to_bin(
+                    np.where(isnan, np.inf, col))
         bins = jnp.asarray(bins_np)
         # continued training may hold trees larger than grow_params allows
         L = max(max(t.num_leaves for t in self.models[:n_models]), 2)

@@ -1,35 +1,52 @@
 """Leaf-ordered (DataPartition-style) serial tree growth.
 
 The cached learner in ops/grow.py keeps rows in original order and pays a
-FULL-N stable sort per split to compact the smaller child's rows (plus a
-row gather to collect them) — an O(N) term per split that dominates at
-large N (profiled: 62 x 1.7ms sorts = 105ms of a 164ms tree at N=1M).
-
-This grower instead maintains the reference's DataPartition invariant
-(data_partition.hpp: one index array where every leaf's rows are
-CONTIGUOUS) — but applied to the DATA ITSELF: binned rows and gradient
-digits live physically grouped by leaf.  Splitting leaf ``l`` then only
-touches its own segment:
+FULL-N stable sort per split to compact the smaller child's rows — an O(N)
+term per split that dominates at large N.  This grower instead maintains
+the reference's DataPartition invariant (data_partition.hpp: one index
+array where every leaf's rows are CONTIGUOUS) — applied to the DATA
+ITSELF: binned rows and gradient digits live physically grouped by leaf.
+Splitting leaf ``l`` then only touches its own segment:
 
   * the split feature column is a contiguous dynamic slice (no gather),
-  * the stable left/right partition is a segment-local sort whose cost is
-    proportional to the PARENT segment (padded to a power-of-two class),
-    not to N — sum over a tree ~ O(N * depth) instead of O(N * leaves),
-  * the smaller child's histogram kernel reads a contiguous slice
-    (no gather at all anywhere in the loop),
+  * the stable left/right partition is a segment-local 12-operand sort
+    whose cost tracks the PARENT segment (padded to a power-of-two class),
+    not N — sum over a tree ~ O(N * depth) instead of O(N * leaves),
+  * the smaller child's histogram kernel reads a contiguous slice,
   * the sibling histogram comes from the exact int32 parent-cache
-    subtraction (ops/leafhist.py), as before.
+    subtraction (ops/leafhist.py).
 
-Row payloads travel through the sort bit-packed as i32 lanes (7 words of
-bins + 3 words of digits + original row id); the window suffix beyond the
-segment gets sort key 2 so the stable sort provably leaves it in place
-(the suffix IS the tail of the window, all-equal keys, stability).
-The lane packing assumes uint8 bins (max_bin <= 256); GBDT._make_grow_fn
-routes uint16 datasets to the cached learner instead.
+Row payloads travel through the sort as WORD-MAJOR i32 lanes (7 words of
+bins + 3 words of digits + original row id, each a separate 1-D array, so
+every slice/sort operand/write-back is contiguous).  The window suffix
+beyond the segment gets sort key 2 so the stable sort provably leaves it
+in place (the suffix IS the tail of the window, all-equal keys,
+stability).  The lane packing assumes uint8 bins (max_bin <= 256);
+GBDT._make_grow_fn routes uint16 datasets to the cached learner instead.
+
+Alternatives measured and rejected on TPU (tools/probe_primitives.py,
+docs/BENCH_NOTES_r03.md): XLA row gathers run ~12-200 ns/row (lowered
+per-index), so permutation-only layouts that gather payloads on demand
+are 2x SLOWER end-to-end; the 12-operand bitonic sort at ~6 ms per 1M
+rows remains the fastest stable partition XLA offers.
+
+Per-step bookkeeping (SplitInfo/LeafSplits, serial_tree_learner.cpp:
+167-224) lives in three PACKED buffers so a step issues ~12 indexed
+device ops instead of ~40 scalar SoA updates (the round-2 ablation's
+~36 ms/tree dispatch floor):
+
+  leaf_f32 [L, 8]: best_gain, best_left_g/h/c, total_g/h/c, cur_value
+  leaf_i32 [L, 8]: best_feat, best_bin, parent, depth, seg_start, seg_cnt
+  node_i32 [L-1, 8]: feature, bin, gain(bits), left, right, value(bits),
+                     count  (f32 fields stored bitcast — storage only)
+
+The per-row leaf assignment is NOT maintained per step (the round-2
+implementation paid a full-[N] select per split): leaf segments are
+contiguous, so it is reconstructed once per tree from (seg_start,
+seg_cnt) with one searchsorted + one scatter back to original row order.
 
 Outputs are identical to ops/grow.py's serial learner: the same splits,
-the same TreeArrays, and leaf_id/delta scattered back to original row
-order (one scatter per TREE, not per split).
+the same TreeArrays (int histogram sums are order-invariant).
 """
 
 from __future__ import annotations
@@ -40,9 +57,14 @@ import jax
 import jax.numpy as jnp
 
 from . import leafhist
-from .grow import GrowParams, TreeArrays, _GrowState, _store_leaf_split
-from .split import BestSplit, SplitParams, find_best_split, leaf_output, \
-    K_MIN_SCORE
+from .grow import GrowParams, TreeArrays
+from .split import BestSplit, find_best_split, leaf_output, K_MIN_SCORE
+
+# Column layout of the packed per-leaf / per-node state buffers.
+_LF = dict(best_gain=0, best_left_g=1, best_left_h=2, best_left_c=3,
+           total_g=4, total_h=5, total_c=6, cur_value=7)
+_LI = dict(best_feat=0, best_bin=1, parent=2, depth=3, start=4, cnt=5)
+_ND = dict(feature=0, bin=1, gain=2, left=3, right=4, value=5, count=6)
 
 
 def _size_classes(n: int, smallest: int = 8192):
@@ -56,32 +78,52 @@ def _size_classes(n: int, smallest: int = 8192):
     return tuple(out)
 
 
-def _pack_u8_rows(x_u8):
-    """[N, C] u8 -> [N, ceil(C/4)] i32 (bit-packed lanes)."""
+def pack_u8_words(x_u8):
+    """[N, C] u8 -> tuple of ceil(C/4) [N] i32 word arrays (bit-packed)."""
     n, c = x_u8.shape
     w = -(-c // 4)
     pad = w * 4 - c
     if pad:
         x_u8 = jnp.pad(x_u8, ((0, 0), (0, pad)))
-    return jax.lax.bitcast_convert_type(
-        x_u8.reshape(n, w, 4), jnp.int32)
+    words = jax.lax.bitcast_convert_type(
+        x_u8.reshape(n, w, 4), jnp.int32)               # [N, w]
+    return tuple(words[:, i] for i in range(w))
 
 
-def _unpack_u8_rows(x_i32, c: int):
-    """[N, W] i32 -> [N, c] u8."""
-    u8 = jax.lax.bitcast_convert_type(x_i32, jnp.uint8)
-    return u8.reshape(x_i32.shape[0], -1)[:, :c]
+def _unpack_words(cols, c: int):
+    """tuple of W [P] i32 -> [P, c] u8."""
+    stacked = jnp.stack(cols, axis=1)                    # [P, W]
+    u8 = jax.lax.bitcast_convert_type(stacked, jnp.uint8)
+    return u8.reshape(stacked.shape[0], -1)[:, :c]
+
+
+def _f2i(x):
+    return jax.lax.bitcast_convert_type(x, jnp.int32)
+
+
+def _i2f(x):
+    return jax.lax.bitcast_convert_type(x, jnp.float32)
+
+
+def _row(buf, i, w: int):
+    """One row of a packed [R, w] buffer as a [w] vector."""
+    return jax.lax.dynamic_slice(buf, (i, 0), (1, w))[0]
+
+
+def _put_row(buf, i, vec):
+    return jax.lax.dynamic_update_slice(buf, vec[None, :], (i, 0))
 
 
 @functools.partial(jax.jit, static_argnames=("params",))
 def grow_tree_ordered(bins, num_bin, is_cat, feat_mask, grad, hess,
                       row_weight, learning_rate, params: GrowParams,
-                      bins_rm=None):
+                      bins_rm=None, bins_words=None):
     """Drop-in replacement for ops.grow.grow_tree (serial learner only).
 
-    Args/returns: see grow_tree.  ``bins_rm`` ([N, F] row-major) is used
-    as the initial physical layout; ``bins`` is only used for its shape
-    and dtype (the feature-major copy never enters the loop)."""
+    Args/returns: see grow_tree.  ``bins_rm`` ([N, F] row-major) feeds the
+    root histogram; ``bins_words`` (tuple of ceil(F/4) [N] i32 arrays from
+    pack_u8_words, shared across trees) seeds the physical layout —
+    derived from bins_rm when omitted."""
     L = params.num_leaves
     B = params.max_bin
     F, N = bins.shape
@@ -89,6 +131,8 @@ def grow_tree_ordered(bins, num_bin, is_cat, feat_mask, grad, hess,
 
     if bins_rm is None:
         bins_rm = bins.T
+    if bins_words is None:
+        bins_words = pack_u8_words(bins_rm)
 
     g = grad * row_weight
     h = hess * row_weight
@@ -102,15 +146,17 @@ def grow_tree_ordered(bins, num_bin, is_cat, feat_mask, grad, hess,
 
     classes = _size_classes(N)
     PAD = classes[-1]          # windows may overrun the last segment
-    W = -(-F // 4)
+    W = len(bins_words)
 
-    bins_pk = jnp.pad(_pack_u8_rows(bins_rm), ((0, PAD), (0, 0)))
-    dig_pk = jnp.pad(
-        _pack_u8_rows(jax.lax.bitcast_convert_type(digits, jnp.uint8)),
-        ((0, PAD), (0, 0)))                         # [N+PAD, 3] i32
-    DW = dig_pk.shape[1]
+    # callers (GBDT._DeviceData) pre-pad the shared bin words once per
+    # dataset; pad here only when handed bare [N] words
+    bins_w = tuple(bw if bw.shape[0] >= N + PAD
+                   else jnp.pad(bw, (0, N + PAD - bw.shape[0]))
+                   for bw in bins_words)
+    dig_w = tuple(jnp.pad(dw, (0, PAD)) for dw in pack_u8_words(
+        jax.lax.bitcast_convert_type(digits, jnp.uint8)))
+    DW = len(dig_w)
     row_ord = jnp.pad(jnp.arange(N, dtype=jnp.int32), (0, PAD))
-    leaf_of_pos = jnp.zeros(N, jnp.int32)
 
     # root histogram over the initial (original-order) layout
     sums_root = leafhist.digit_histogram(bins_rm, digits, B)
@@ -120,49 +166,37 @@ def grow_tree_ordered(bins, num_bin, is_cat, feat_mask, grad, hess,
                                  jnp.asarray(True), sp)
     cache = jnp.zeros((L, F, 9, B), jnp.int32).at[0].set(sums_root)
 
-    neg_inf = jnp.full((L,), K_MIN_SCORE, dtype=jnp.float32)
-    state = _GrowState(
-        leaf_id=leaf_of_pos,   # repurposed: leaf per POSITION (ordered)
-        num_leaves=jnp.asarray(1, jnp.int32),
-        stopped=jnp.asarray(False),
-        best_gain=neg_inf.at[0].set(root_split.gain),
-        best_feat=jnp.zeros((L,), jnp.int32).at[0].set(root_split.feature),
-        best_bin=jnp.zeros((L,), jnp.int32).at[0].set(root_split.threshold),
-        best_left_g=jnp.zeros((L,), jnp.float32).at[0].set(
-            root_split.left_sum_g),
-        best_left_h=jnp.zeros((L,), jnp.float32).at[0].set(
-            root_split.left_sum_h),
-        best_left_c=jnp.zeros((L,), jnp.float32).at[0].set(
-            root_split.left_count),
-        total_g=jnp.zeros((L,), jnp.float32).at[0].set(root_g),
-        total_h=jnp.zeros((L,), jnp.float32).at[0].set(root_h),
-        total_c=jnp.zeros((L,), jnp.float32).at[0].set(root_c),
-        cur_value=jnp.zeros((L,), jnp.float32),
-        leaf_parent=jnp.full((L,), -1, jnp.int32),
-        leaf_depth=jnp.zeros((L,), jnp.int32),
-        split_feature=jnp.full((L - 1,), -1, jnp.int32),
-        split_bin=jnp.zeros((L - 1,), jnp.int32),
-        split_gain=jnp.zeros((L - 1,), jnp.float32),
-        left_child=jnp.zeros((L - 1,), jnp.int32),
-        right_child=jnp.zeros((L - 1,), jnp.int32),
-        internal_value=jnp.zeros((L - 1,), jnp.float32),
-        internal_count=jnp.zeros((L - 1,), jnp.int32),
-    )
-    leaf_start = jnp.zeros((L,), jnp.int32)
-    leaf_cnt = jnp.zeros((L,), jnp.int32).at[0].set(N)
+    root_f32 = jnp.stack([
+        root_split.gain, root_split.left_sum_g, root_split.left_sum_h,
+        root_split.left_count, root_g, root_h, root_c,
+        jnp.float32(0.0)])
+    leaf_f32 = jnp.full((L, 8), K_MIN_SCORE, jnp.float32) \
+        .at[:, 1:].set(0.0).at[0].set(root_f32)
+    root_i32 = jnp.array([0, 0, -1, 0, 0, 0, 0, 0], jnp.int32) \
+        .at[_LI["best_feat"]].set(root_split.feature) \
+        .at[_LI["best_bin"]].set(root_split.threshold) \
+        .at[_LI["cnt"]].set(N)
+    leaf_i32 = jnp.zeros((L, 8), jnp.int32) \
+        .at[:, _LI["parent"]].set(-1).at[0].set(root_i32)
+    empty_node = jnp.zeros((8,), jnp.int32).at[_ND["feature"]].set(-1)
+    node_i32 = jnp.broadcast_to(empty_node, (L - 1, 8))
 
     def make_branch(P: int):
-        P2 = max(P // 2, classes[0] // 2, 4096)
-
         def branch(ops):
-            (bins_pk, dig_pk, row_ord, s, c, feat, tbin, cat, do_split) = ops
-            win_b = jax.lax.dynamic_slice(bins_pk, (s, 0), (P, W))
-            win_d = jax.lax.dynamic_slice(dig_pk, (s, 0), (P, DW))
+            (bins_w, dig_w, row_ord, s, c, feat, tbin, cat, do_split) = ops
+            win_b = tuple(jax.lax.dynamic_slice(bw, (s,), (P,))
+                          for bw in bins_w)
+            win_d = tuple(jax.lax.dynamic_slice(dw, (s,), (P,))
+                          for dw in dig_w)
             win_r = jax.lax.dynamic_slice(row_ord, (s,), (P,))
 
             word = feat // 4
             byte = feat % 4
-            col32 = jax.lax.dynamic_slice(win_b, (0, word), (P, 1))[:, 0]
+            # dynamic word pick as a select chain (a lax.switch here costs
+            # 7 branch bodies x 8 size classes of compile time)
+            col32 = win_b[0]
+            for i in range(1, W):
+                col32 = jnp.where(word == i, win_b[i], col32)
             fcol = (col32 >> (8 * byte)) & 0xFF
             go_r = jnp.where(cat, fcol != tbin, fcol > tbin)
             iota = jnp.arange(P, dtype=jnp.int32)
@@ -172,15 +206,16 @@ def grow_tree_ordered(bins, num_bin, is_cat, feat_mask, grad, hess,
             key = jnp.where(do_split & inseg,
                             go_r.astype(jnp.uint8), jnp.uint8(2))
 
-            operands = (key,) + tuple(win_b[:, i] for i in range(W)) \
-                + tuple(win_d[:, i] for i in range(DW)) + (win_r,)
+            operands = (key,) + win_b + win_d + (win_r,)
             sorted_ops = jax.lax.sort(operands, num_keys=1, is_stable=True)
-            sb = jnp.stack(sorted_ops[1:1 + W], axis=1)
-            sd = jnp.stack(sorted_ops[1 + W:1 + W + DW], axis=1)
+            sb = sorted_ops[1:1 + W]
+            sd = sorted_ops[1 + W:1 + W + DW]
             sr = sorted_ops[-1]
 
-            bins_pk = jax.lax.dynamic_update_slice(bins_pk, sb, (s, 0))
-            dig_pk = jax.lax.dynamic_update_slice(dig_pk, sd, (s, 0))
+            bins_w = tuple(jax.lax.dynamic_update_slice(bw, nb, (s,))
+                           for bw, nb in zip(bins_w, sb))
+            dig_w = tuple(jax.lax.dynamic_update_slice(dw, nd, (s,))
+                          for dw, nd in zip(dig_w, sd))
             row_ord = jax.lax.dynamic_update_slice(row_ord, sr, (s,))
 
             cnt_r = jnp.sum((go_r & inseg).astype(jnp.int32))
@@ -188,19 +223,27 @@ def grow_tree_ordered(bins, num_bin, is_cat, feat_mask, grad, hess,
 
             # smaller child's histogram from its CONTIGUOUS slice; pad to
             # P/8 when the child is small enough (splits are often very
-            # unbalanced — a fixed P/2 pad wastes up to 4x kernel work)
+            # unbalanced — a fixed P/2 pad wastes up to 4x kernel work).
+            # Measured dead ends (tools/probe_dynhist.py): a dynamic-grid
+            # packed-word kernel runs 3x slower per row (Mosaic keeps all
+            # one-hot temporaries live under a dynamic grid, forcing tiny
+            # blocks), so the static size-class structure stays.
             small_left = cnt_l <= cnt_r
             off = s + jnp.where(small_left, 0, cnt_l)
             scnt = jnp.minimum(cnt_l, cnt_r)
 
             def hist_at(Psz):
                 def h(_):
-                    ch_b = jax.lax.dynamic_slice(bins_pk, (off, 0), (Psz, W))
-                    ch_d = jax.lax.dynamic_slice(dig_pk, (off, 0), (Psz, DW))
-                    ch_bins = _unpack_u8_rows(ch_b, F)
+                    ch_bins = _unpack_words(
+                        tuple(jax.lax.dynamic_slice(bw, (off,), (Psz,))
+                              for bw in bins_w), F)
                     ch_dig = jax.lax.bitcast_convert_type(
-                        jax.lax.bitcast_convert_type(ch_d, jnp.uint8)
-                        .reshape(Psz, -1)[:, :9], jnp.int8)
+                        jax.lax.bitcast_convert_type(
+                            jnp.stack(
+                                tuple(jax.lax.dynamic_slice(
+                                    dw, (off,), (Psz,)) for dw in dig_w),
+                                axis=1),
+                            jnp.uint8).reshape(Psz, -1)[:, :9], jnp.int8)
                     ch_dig = jnp.where(
                         jnp.arange(Psz, dtype=jnp.int32)[:, None] < scnt,
                         ch_dig, 0)
@@ -211,112 +254,78 @@ def grow_tree_ordered(bins, num_bin, is_cat, feat_mask, grad, hess,
                                                             ch_dig, B)
                 return h
 
+            P2 = max(P // 2, classes[0] // 2, 4096)
             P8 = max(P // 8, 4096)
             if P8 < P2:
                 sums_small = jax.lax.cond(scnt <= P8, hist_at(P8),
                                           hist_at(P2), None)
             else:
                 sums_small = hist_at(P2)(None)
-            return bins_pk, dig_pk, row_ord, cnt_l, small_left, sums_small
+            return bins_w, dig_w, row_ord, cnt_l, small_left, sums_small
         return branch
 
     branches = [make_branch(P) for P in classes]
     sizes_arr = jnp.asarray(classes, jnp.int32)
 
     def step(k, carry):
-        (state, cache, bins_pk, dig_pk, row_ord, leaf_start, leaf_cnt) = carry
-        best_leaf = jnp.argmax(state.best_gain).astype(jnp.int32)
-        gain = state.best_gain[best_leaf]
-        do_split = jnp.logical_and(~state.stopped, gain > 0.0)
+        (num_leaves, stopped, leaf_f32, leaf_i32, node_i32, cache,
+         bins_w, dig_w, row_ord) = carry
+        gains = leaf_f32[:, _LF["best_gain"]]
+        best_leaf = jnp.argmax(gains).astype(jnp.int32)
+        gain = gains[best_leaf]
+        do_split = jnp.logical_and(~stopped, gain > 0.0)
         stopped = ~do_split
+        right_leaf = num_leaves
 
-        feat = jnp.maximum(state.best_feat[best_leaf], 0)
-        tbin = state.best_bin[best_leaf]
-        right_leaf = state.num_leaves
-        s = leaf_start[best_leaf]
-        c = leaf_cnt[best_leaf]
+        rb_f = _row(leaf_f32, best_leaf, 8)
+        rb_i = _row(leaf_i32, best_leaf, 8)
+        rr_f = _row(leaf_f32, right_leaf, 8)
+        rr_i = _row(leaf_i32, right_leaf, 8)
+
+        feat = jnp.maximum(rb_i[_LI["best_feat"]], 0)
+        tbin = rb_i[_LI["best_bin"]]
+        s = rb_i[_LI["start"]]
+        c = rb_i[_LI["cnt"]]
+        depth = rb_i[_LI["depth"]]
+        parent_node = rb_i[_LI["parent"]]
 
         cls = jnp.minimum(jnp.sum(c > sizes_arr).astype(jnp.int32),
                           len(branches) - 1)
-        bins_pk, dig_pk, row_ord, cnt_l, small_left, sums_small = \
+        bins_w, dig_w, row_ord, cnt_l, small_left, sums_small = \
             jax.lax.switch(cls, branches,
-                           (bins_pk, dig_pk, row_ord, s, c, feat, tbin,
+                           (bins_w, dig_w, row_ord, s, c, feat, tbin,
                             is_cat[feat], do_split))
 
-        # --- split sums / tree structure (identical to ops/grow.py) ----
-        parent_g = state.total_g[best_leaf]
-        parent_h = state.total_h[best_leaf]
-        parent_c = state.total_c[best_leaf]
-        left_g = state.best_left_g[best_leaf]
-        left_h = state.best_left_h[best_leaf]
-        left_c = state.best_left_c[best_leaf]
+        # --- split sums (exact reference decomposition) -----------------
+        parent_g = rb_f[_LF["total_g"]]
+        parent_h = rb_f[_LF["total_h"]]
+        parent_c = rb_f[_LF["total_c"]]
+        left_g = rb_f[_LF["best_left_g"]]
+        left_h = rb_f[_LF["best_left_h"]]
+        left_c = rb_f[_LF["best_left_c"]]
         right_g = parent_g - left_g
         right_h = parent_h - left_h
         right_c = parent_c - left_c
         left_val = leaf_output(left_g, left_h, sp.lambda_l1, sp.lambda_l2)
         right_val = leaf_output(right_g, right_h, sp.lambda_l1, sp.lambda_l2)
 
+        # --- node record + parent child-pointer fixup -------------------
         node = k
-        parent_node = state.leaf_parent[best_leaf]
         p_safe = jnp.maximum(parent_node, 0)
-        was_left = state.left_child[p_safe] == ~best_leaf
+        rp = _row(node_i32, p_safe, 8)
+        was_left = rp[_ND["left"]] == ~best_leaf
         upd_parent = do_split & (parent_node >= 0)
-        left_child = state.left_child.at[p_safe].set(
-            jnp.where(upd_parent & was_left, node, state.left_child[p_safe]))
-        right_child = state.right_child.at[p_safe].set(
-            jnp.where(upd_parent & ~was_left, node,
-                      state.right_child[p_safe]))
-
-        def upd(arr, value):
-            return arr.at[node].set(jnp.where(do_split, value, arr[node]))
-
-        depth = state.leaf_depth[best_leaf]
-        new_leaf_of_pos = jnp.where(
-            do_split
-            & (jnp.arange(N, dtype=jnp.int32) >= s + cnt_l)
-            & (jnp.arange(N, dtype=jnp.int32) < s + c),
-            right_leaf, state.leaf_id)
-
-        new_state = state._replace(
-            leaf_id=new_leaf_of_pos,
-            num_leaves=state.num_leaves + jnp.where(do_split, 1, 0),
-            stopped=stopped,
-            split_feature=upd(state.split_feature,
-                              state.best_feat[best_leaf]),
-            split_bin=upd(state.split_bin, tbin),
-            split_gain=upd(state.split_gain, gain),
-            left_child=upd(left_child, ~best_leaf),
-            right_child=upd(right_child, ~right_leaf),
-            internal_value=upd(state.internal_value,
-                               state.cur_value[best_leaf]),
-            internal_count=upd(state.internal_count,
-                               parent_c.astype(jnp.int32)),
-            total_g=state.total_g.at[best_leaf].set(
-                jnp.where(do_split, left_g, parent_g))
-                .at[right_leaf].set(jnp.where(do_split, right_g, 0.0)),
-            total_h=state.total_h.at[best_leaf].set(
-                jnp.where(do_split, left_h, parent_h))
-                .at[right_leaf].set(jnp.where(do_split, right_h, 0.0)),
-            total_c=state.total_c.at[best_leaf].set(
-                jnp.where(do_split, left_c, parent_c))
-                .at[right_leaf].set(jnp.where(do_split, right_c, 0.0)),
-            cur_value=state.cur_value.at[best_leaf].set(
-                jnp.where(do_split, left_val, state.cur_value[best_leaf]))
-                .at[right_leaf].set(jnp.where(do_split, right_val, 0.0)),
-            leaf_parent=state.leaf_parent.at[best_leaf].set(
-                jnp.where(do_split, node, parent_node))
-                .at[right_leaf].set(jnp.where(do_split, node, -1)),
-            leaf_depth=state.leaf_depth.at[best_leaf].set(
-                jnp.where(do_split, depth + 1, depth))
-                .at[right_leaf].set(jnp.where(do_split, depth + 1, 0)),
-        )
-        leaf_start = leaf_start.at[right_leaf].set(
-            jnp.where(do_split, s + cnt_l, leaf_start[right_leaf]),
-            mode="drop")
-        leaf_cnt = leaf_cnt.at[best_leaf].set(
-            jnp.where(do_split, cnt_l, c)) \
-            .at[right_leaf].set(jnp.where(do_split, c - cnt_l,
-                                          leaf_cnt[right_leaf]), mode="drop")
+        rp = rp.at[_ND["left"]].set(
+            jnp.where(upd_parent & was_left, node, rp[_ND["left"]]))
+        rp = rp.at[_ND["right"]].set(
+            jnp.where(upd_parent & ~was_left, node, rp[_ND["right"]]))
+        node_i32 = _put_row(node_i32, p_safe, rp)
+        new_node = jnp.stack([
+            rb_i[_LI["best_feat"]], tbin, _f2i(gain), ~best_leaf,
+            ~right_leaf, _f2i(rb_f[_LF["cur_value"]]),
+            parent_c.astype(jnp.int32), jnp.int32(0)])
+        node_i32 = _put_row(node_i32, node,
+                            jnp.where(do_split, new_node, empty_node))
 
         # --- child histograms via exact sibling subtraction -------------
         sums_parent = cache[best_leaf]
@@ -338,56 +347,64 @@ def grow_tree_ordered(bins, num_bin, is_cat, feat_mask, grad, hess,
             jnp.stack([left_h, right_h]), jnp.stack([left_c, right_c]),
             num_bin, is_cat, feat_mask, can, sp)
 
-        new_state = new_state._replace(
-            best_gain=new_state.best_gain.at[best_leaf].set(
-                jnp.where(do_split, K_MIN_SCORE,
-                          new_state.best_gain[best_leaf])))
-        left_rec = jax.tree.map(lambda a: a[0], child_split)
-        right_rec = jax.tree.map(lambda a: a[1], child_split)
-        store_left = jax.tree.map(
-            lambda cur, new: jnp.where(do_split, new, cur),
-            BestSplit(new_state.best_gain[best_leaf],
-                      new_state.best_feat[best_leaf],
-                      new_state.best_bin[best_leaf],
-                      new_state.best_left_g[best_leaf],
-                      new_state.best_left_h[best_leaf],
-                      new_state.best_left_c[best_leaf]),
-            left_rec)
-        new_state = _store_leaf_split(new_state, best_leaf, store_left)
-        store_right = jax.tree.map(
-            lambda cur, new: jnp.where(do_split, new, cur),
-            BestSplit(new_state.best_gain[right_leaf],
-                      new_state.best_feat[right_leaf],
-                      new_state.best_bin[right_leaf],
-                      new_state.best_left_g[right_leaf],
-                      new_state.best_left_h[right_leaf],
-                      new_state.best_left_c[right_leaf]),
-            right_rec)
-        new_state = _store_leaf_split(new_state, right_leaf, store_right)
-        return (new_state, cache, bins_pk, dig_pk, row_ord, leaf_start,
-                leaf_cnt)
+        def leaf_rows(ci, tot_g, tot_h, tot_c, val, seg_s, seg_c):
+            f32 = jnp.stack([
+                child_split.gain[ci], child_split.left_sum_g[ci],
+                child_split.left_sum_h[ci], child_split.left_count[ci],
+                tot_g, tot_h, tot_c, val])
+            i32 = jnp.stack([
+                child_split.feature[ci], child_split.threshold[ci],
+                node, depth + 1, seg_s, seg_c, jnp.int32(0), jnp.int32(0)])
+            return f32, i32
 
-    carry = (state, cache, bins_pk, dig_pk, row_ord, leaf_start, leaf_cnt)
-    state, cache, bins_pk, dig_pk, row_ord, leaf_start, leaf_cnt = \
+        lf, li = leaf_rows(0, left_g, left_h, left_c, left_val, s, cnt_l)
+        rf, ri = leaf_rows(1, right_g, right_h, right_c, right_val,
+                           s + cnt_l, c - cnt_l)
+        leaf_f32 = _put_row(leaf_f32, best_leaf,
+                            jnp.where(do_split, lf, rb_f))
+        leaf_i32 = _put_row(leaf_i32, best_leaf,
+                            jnp.where(do_split, li, rb_i))
+        leaf_f32 = _put_row(leaf_f32, right_leaf,
+                            jnp.where(do_split, rf, rr_f))
+        leaf_i32 = _put_row(leaf_i32, right_leaf,
+                            jnp.where(do_split, ri, rr_i))
+        num_leaves = num_leaves + jnp.where(do_split, 1, 0)
+        return (num_leaves, stopped, leaf_f32, leaf_i32, node_i32, cache,
+                bins_w, dig_w, row_ord)
+
+    carry = (jnp.asarray(1, jnp.int32), jnp.asarray(False),
+             leaf_f32, leaf_i32, node_i32, cache, bins_w, dig_w, row_ord)
+    (num_leaves, _, leaf_f32, leaf_i32, node_i32, _, _, _, row_ord) = \
         jax.lax.fori_loop(0, L - 1, step, carry)
 
-    shrunk = state.cur_value * learning_rate
+    shrunk = leaf_f32[:, _LF["cur_value"]] * learning_rate
     tree = TreeArrays(
-        num_leaves=state.num_leaves,
-        split_feature=state.split_feature,
-        split_bin=state.split_bin,
-        split_gain=state.split_gain,
-        left_child=state.left_child,
-        right_child=state.right_child,
-        internal_value=state.internal_value,
-        internal_count=state.internal_count,
+        num_leaves=num_leaves,
+        split_feature=node_i32[:, _ND["feature"]],
+        split_bin=node_i32[:, _ND["bin"]],
+        split_gain=_i2f(node_i32[:, _ND["gain"]]),
+        left_child=node_i32[:, _ND["left"]],
+        right_child=node_i32[:, _ND["right"]],
+        internal_value=_i2f(node_i32[:, _ND["value"]]),
+        internal_count=node_i32[:, _ND["count"]],
         leaf_value=shrunk,
-        leaf_count=state.total_c.astype(jnp.int32),
-        leaf_parent=state.leaf_parent,
-        leaf_depth=state.leaf_depth,
+        leaf_count=leaf_f32[:, _LF["total_c"]].astype(jnp.int32),
+        leaf_parent=leaf_i32[:, _LI["parent"]],
+        leaf_depth=leaf_i32[:, _LI["depth"]],
     )
+
+    # Per-position leaf assignment from the contiguous segments: the leaf
+    # owning position p is the one with the largest seg_start <= p.
+    leaf_iota = jnp.arange(L, dtype=jnp.int32)
+    live = (leaf_iota < num_leaves) & (leaf_i32[:, _LI["cnt"]] > 0)
+    sv = jnp.where(live, leaf_i32[:, _LI["start"]], jnp.int32(N))
+    sv_sorted, leaf_sorted = jax.lax.sort((sv, leaf_iota), num_keys=1,
+                                          is_stable=True)
+    pos = jnp.arange(N, dtype=jnp.int32)
+    seg = jnp.searchsorted(sv_sorted, pos, side="right") - 1
+    leaf_of_pos = leaf_sorted[seg]
     # back to ORIGINAL row order: one scatter per tree
     leaf_id = jnp.zeros(N, jnp.int32).at[row_ord[:N]].set(
-        state.leaf_id, unique_indices=True)
+        leaf_of_pos, unique_indices=True)
     output_delta = shrunk[leaf_id]
     return tree, leaf_id, output_delta

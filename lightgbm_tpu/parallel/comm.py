@@ -202,11 +202,12 @@ class VotingParallelComm(NamedTuple):
     Per leaf: local per-feature best gains (per_feature_scan on the LOCAL
     histogram with locally derived totals and 1/num_shards-scaled
     constraints, voting_parallel_tree_learner.cpp:52-54) -> local top-k
-    feature ids -> all_gather candidates -> election by summed local gain
-    (GlobalVoting, 157-186) -> psum of only the elected features'
-    histograms (CopyLocalHistogram + ReduceScatter, 188-244) -> exact split
-    on elected features against GLOBAL totals -> winner (already replicated,
-    no final reduce needed).
+    feature ids by unweighted local gain -> all_gather candidates ->
+    election by per-feature MAX of count-weighted local gain (GlobalVoting,
+    157-186) -> psum of only the elected features' histograms
+    (CopyLocalHistogram + ReduceScatter, 188-244) -> exact split on elected
+    features against GLOBAL totals -> winner (already replicated, no final
+    reduce needed).
     """
     axis_name: str = "data"
     num_shards: int = 1
@@ -238,36 +239,53 @@ class VotingParallelComm(NamedTuple):
         feat_gain, _, _, _, _ = per_feature_scan(
             hist, loc_g, loc_h, loc_c, num_bin, is_cat, feat_mask,
             local_sp)                                      # [C, F]
-        # Vote weight = true local split gain (parent shift subtracted)
-        # scaled by the leaf's local row count, mirroring GlobalVoting's
-        # gain * (left_count + right_count) weighting
-        # (voting_parallel_tree_learner.cpp:157-186).
+        # Local proposals: top-k features by the true local split gain
+        # (parent shift subtracted), UNWEIGHTED — exactly the per-machine
+        # MaxK over FindBestThreshold outputs
+        # (voting_parallel_tree_learner.cpp:322-326).
         shift = leaf_split_gain(loc_g, loc_h, local_sp.lambda_l1,
                                 local_sp.lambda_l2)        # [C]
-        score = jnp.where(jnp.isfinite(feat_gain),
-                          jnp.maximum(feat_gain - shift[:, None], 0.0)
-                          * loc_c[:, None], 0.0)           # [C, F]
-        top_gain, top_ids = lax.top_k(score, K)            # [C, K]
+        gain_local = jnp.where(jnp.isfinite(feat_gain),
+                               feat_gain - shift[:, None],
+                               -jnp.inf)                   # [C, F]
+        top_gain, top_ids = lax.top_k(gain_local, K)       # [C, K]
+        # GlobalVoting's vote weight is gain * (left_count + right_count)
+        # / mean_num_data (voting_parallel_tree_learner.cpp:157-173);
+        # left+right is the proposing machine's LOCAL leaf count.
+        mean_cnt = jnp.maximum(totals_c / self.num_shards, 1.0)  # [C]
+        top_w = jnp.where(jnp.isfinite(top_gain),
+                          top_gain * loc_c[:, None] / mean_cnt[:, None],
+                          -jnp.inf)
 
-        # ---- GlobalVoting: score features by summed weighted local gains
-        gains_all = lax.all_gather(top_gain, self.axis_name)   # [S, C, K]
+        # ---- GlobalVoting: per-feature MAX of weighted local gains over
+        # machines, then top-k (NOT a sum: cpp:168-173 keeps the best
+        # weighted proposal per feature).
+        w_all = lax.all_gather(top_w, self.axis_name)          # [S, C, K]
         ids_all = lax.all_gather(top_ids, self.axis_name)      # [S, C, K]
-        votes = jnp.zeros((C, F), jnp.float32)
+        votes = jnp.full((C, F), -jnp.inf, jnp.float32)
         flat_ids = ids_all.transpose(1, 0, 2).reshape(C, -1)   # [C, S*K]
-        flat_gain = gains_all.transpose(1, 0, 2).reshape(C, -1)
-        votes = jax.vmap(lambda v, i, s: v.at[i].add(s))(
-            votes, flat_ids, flat_gain)
-        _, elected = lax.top_k(votes, K)                   # [C, K] global ids
+        flat_w = w_all.transpose(1, 0, 2).reshape(C, -1)
+        votes = jax.vmap(lambda v, i, s: v.at[i].max(s))(
+            votes, flat_ids, flat_w)
+        vote_val, elected = lax.top_k(votes, K)            # [C, K] global ids
+        # GlobalVoting drops entries nobody proposed (gain == kMinScore or
+        # feature == -1, cpp:177-185): with fewer than K genuine proposals
+        # top_k pads with arbitrary -inf-vote features — mask them out of
+        # the exact scan instead of electing them.
+        voted = jnp.isfinite(vote_val)                     # [C, K]
         # Ascending feature order keeps the final argmax tie-break identical
         # to the serial scan (smallest feature index wins).
-        elected = jnp.sort(elected, axis=-1)
+        order = jnp.argsort(jnp.where(voted, elected, jnp.int32(1 << 30)),
+                            axis=-1)
+        elected = jnp.take_along_axis(elected, order, axis=-1)
+        voted = jnp.take_along_axis(voted, order, axis=-1)
 
         # ---- reduce only the elected features' histograms ----------------
         hist_el = jax.vmap(lambda hc, ids: hc[ids])(hist, elected)
         hist_el = lax.psum(hist_el, self.axis_name)        # [C, K, B, 3]
         nb_el = num_bin[elected]
         ic_el = is_cat[elected]
-        fm_el = feat_mask[elected]
+        fm_el = feat_mask[elected] & voted
 
         def _one(hist_c, tg, th, tc, cn, nb, ic, fm):
             return find_best_split(hist_c, tg, th, tc, nb, ic, fm, cn, sp)

@@ -143,12 +143,18 @@ def global_sample_indices(num_data: int, sample_cnt: int,
 
 def _f64_to_f32x3(x: np.ndarray) -> np.ndarray:
     """[3, ...] f32 components whose sum reconstructs x exactly (24+24+24
-    mantissa bits > f64's 53).  Devices run f32; host reassembles f64."""
-    hi = x.astype(np.float32)
+    mantissa bits > f64's 53).  Devices run f32; host reassembles f64.
+
+    Exact for |x| <= f32 max; finite values beyond that saturate through
+    cascading clamps (sum ~ +-1.02e39, then +-inf) instead of the
+    hi=inf/lo=NaN corruption a plain cast residual would produce."""
+    f32max = np.float64(np.finfo(np.float32).max)
     finite = np.isfinite(x)
+    hi = np.where(finite, np.clip(x, -f32max, f32max), x).astype(np.float32)
     r1 = np.where(finite, x - np.where(finite, hi, 0).astype(np.float64), 0.0)
-    mid = r1.astype(np.float32)
-    lo = (r1 - mid.astype(np.float64)).astype(np.float32)
+    mid = np.clip(r1, -f32max, f32max).astype(np.float32)
+    r2 = r1 - mid.astype(np.float64)
+    lo = np.clip(r2, -f32max, f32max).astype(np.float32)
     return np.stack([hi, mid, lo])
 
 

@@ -78,3 +78,52 @@ def test_multiclass_conf(tmp_path):
                   "num_trees=5"])
     assert rc == 0
     assert (tmp_path / "LightGBM_model.txt").exists()
+
+
+def _write_tsv(path, y, X):
+    with open(path, "w") as fh:
+        for yi, row in zip(y, X):
+            fh.write(f"{yi:g}\t" + "\t".join(f"{v:.6g}" for v in row) + "\n")
+
+
+def test_chunked_file_predict_and_num_iteration_predict(tmp_path,
+                                                        monkeypatch):
+    """File prediction streams in O(chunk) pieces (predictor.hpp:81-129)
+    and the CLI honors num_iteration_predict (config.h:97): predictions
+    must match the in-memory path exactly, across chunk boundaries, and a
+    truncated model must differ from the full one."""
+    from lightgbm_tpu import Dataset, train
+    from lightgbm_tpu.basic import Booster
+
+    rng = np.random.RandomState(7)
+    n, f = 1000, 8
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    train_file = tmp_path / "chunk.train"
+    _write_tsv(train_file, y, X)
+    bst = train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                 "min_data_in_leaf": 20}, Dataset(X, label=y),
+                num_boost_round=12)
+    model = tmp_path / "model.txt"
+    bst.save_model(str(model))
+
+    # chunk the file into many pieces and compare with in-memory predict
+    monkeypatch.setattr(Booster, "_PREDICT_CHUNK_ROWS", 64)
+    loaded = Booster(model_file=str(model))
+    via_file = loaded.predict(str(train_file))
+    in_mem = loaded.predict(X)
+    np.testing.assert_allclose(via_file, in_mem, rtol=0, atol=0)
+
+    # CLI respects num_iteration_predict
+    conf = tmp_path / "predict.conf"
+    conf.write_text("task = predict\n"
+                    f"data = {train_file}\n"
+                    f"input_model = {model}\n"
+                    "num_iteration_predict = 3\n")
+    rc = _run_in(tmp_path, str(tmp_path), "predict.conf")
+    assert rc == 0
+    out3 = np.loadtxt(tmp_path / "LightGBM_predict_result.txt")
+    # the CLI writes %g (6 significant digits)
+    np.testing.assert_allclose(out3, loaded.predict(X, num_iteration=3),
+                               rtol=1e-5, atol=1e-7)
+    assert not np.allclose(out3, in_mem)

@@ -1,0 +1,155 @@
+"""In-data column roles: weight/group/ignore/categorical columns by index
+or name: prefix (dataset_loader.cpp SetHeader, :22-157), through the
+one-round loader, the two-round streaming loader, and the CLI."""
+
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.basic import LightGBMError
+from lightgbm_tpu.io.column_roles import (qid_to_query_sizes,
+                                          resolve_label_idx, resolve_roles)
+
+
+def _write(path, rows, header=None):
+    with open(path, "w") as fh:
+        if header:
+            fh.write("\t".join(header) + "\n")
+        for r in rows:
+            fh.write("\t".join(f"{v:g}" for v in r) + "\n")
+
+
+def _make_file(tmp_path, header):
+    """label, f0, weight w, f1, qid: label = f0 > 0 (f1 is noise)."""
+    rng = np.random.RandomState(3)
+    n = 400
+    f0 = rng.normal(size=n)
+    f1 = rng.normal(size=n)
+    w = rng.uniform(0.5, 2.0, size=n)
+    qid = np.repeat(np.arange(20), 20)
+    y = (f0 > 0).astype(float)
+    rows = np.column_stack([y, f0, w, f1, qid])
+    path = tmp_path / ("roles_h.tsv" if header else "roles.tsv")
+    _write(path, rows,
+           header=["lab", "a", "w", "b", "qid"] if header else None)
+    return str(path), y, w, qid
+
+
+# ---------------------------------------------------------------------------
+# resolver unit semantics
+# ---------------------------------------------------------------------------
+
+def test_resolver_name_and_index_spaces():
+    full = ["lab", "a", "w", "b", "qid"]
+    assert resolve_label_idx("name:lab", full) == 0
+    assert resolve_label_idx("2", full) == 2
+    assert resolve_label_idx("", full) == 0
+    feats = ["a", "w", "b", "qid"]   # label-removed space
+    r = resolve_roles(weight_column="name:w", group_column="name:qid",
+                      ignore_column="name:b", categorical_column="2",
+                      feature_names=feats)
+    assert r.weight_idx == 1 and r.group_idx == 3
+    # weight+group join the ignore set (dataset_loader.cpp:111,131)
+    assert r.ignore == {1, 2, 3}
+    assert r.categorical == {2}
+
+
+def test_resolver_errors():
+    with pytest.raises(LightGBMError):
+        resolve_roles(weight_column="name:nope", feature_names=["a", "b"])
+    with pytest.raises(LightGBMError):
+        resolve_roles(ignore_column="notanumber", feature_names=None)
+    with pytest.raises(LightGBMError):
+        resolve_label_idx("name:lab", None)
+
+
+def test_qid_run_lengths():
+    assert qid_to_query_sizes([1, 1, 2, 2, 2, 7]) == [2, 3, 1]
+    assert qid_to_query_sizes([]) == []
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the loaders
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("two_round", [False, True])
+@pytest.mark.parametrize("by_name", [False, True])
+def test_roles_through_loader(tmp_path, two_round, by_name):
+    path, y, w, qid = _make_file(tmp_path, header=by_name)
+    if by_name:
+        params = {"has_header": True, "label_column": "name:lab",
+                  "weight_column": "name:w", "group_column": "name:qid",
+                  "ignore_column": "name:b"}
+    else:
+        params = {"label_column": "0", "weight_column": "1",
+                  "group_column": "3", "ignore_column": "2"}
+    params["verbose"] = -1
+    if two_round:
+        params["two_round"] = True
+    ds = lgb.Dataset(path, params=params).construct()
+    binned = ds._binned
+    md = binned.metadata
+    np.testing.assert_allclose(np.asarray(md.label, np.float64), y,
+                               atol=1e-6)
+    # the file carries %g (6 significant digits)
+    np.testing.assert_allclose(np.asarray(md.weights, np.float64), w,
+                               rtol=1e-5)
+    sizes = np.diff(np.asarray(md.query_boundaries))
+    np.testing.assert_array_equal(sizes, np.full(20, 20))
+    # weight/group/ignored columns must not be usable features: only f0
+    # (and maybe the noise f1... f1 is ignored by index 2? no: ignored is
+    # the *b* column) — usable features exclude w, qid, and b.
+    used_real = set(binned.used_feature_map)
+    # feature space order: [a, w, b, qid] -> w=1, b=2, qid=3 excluded
+    assert used_real <= {0}
+    assert 0 in used_real
+
+
+def test_roles_training_weights_differ(tmp_path):
+    """Training with an in-data weight column must differ from unweighted
+    training on the same features (the weights actually flow in)."""
+    path, y, w, qid = _make_file(tmp_path, header=False)
+    common = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+              "min_data_in_leaf": 10, "label_column": "0",
+              "ignore_column": "1,3"}
+    bw = lgb.train({**common, "weight_column": "1",
+                    "ignore_column": "3"},
+                   lgb.Dataset(path, params={**common, "weight_column": "1",
+                                             "ignore_column": "3"}),
+                   num_boost_round=5)
+    bu = lgb.train(common, lgb.Dataset(path, params=common),
+                   num_boost_round=5)
+    s_w = bw.model_to_string()
+    s_u = bu.model_to_string()
+    assert s_w != s_u
+
+
+def test_roles_through_cli(tmp_path):
+    """A conf with weight_column/group_column/ignore_column by name trains
+    and the model ignores the role columns (header + name: path)."""
+    from lightgbm_tpu import cli
+    path, y, w, qid = _make_file(tmp_path, header=True)
+    conf = tmp_path / "train.conf"
+    conf.write_text(
+        "task = train\nobjective = binary\nmetric = auc\n"
+        f"data = {path}\nheader = true\nlabel = name:lab\n"
+        "weight = name:w\ngroup = name:qid\nignore_column = name:b\n"
+        "num_trees = 3\nnum_leaves = 7\nmin_data_in_leaf = 10\n"
+        "verbosity = -1\noutput_model = roles_model.txt\n")
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        rc = cli.main([f"config={conf}"])
+    finally:
+        os.chdir(cwd)
+    assert rc == 0
+    text = (tmp_path / "roles_model.txt").read_text()
+    # the only splittable feature is column a
+    assert "split_feature=" in text
+    for line in text.splitlines():
+        if line.startswith("split_feature="):
+            vals = {int(v) for v in line.split("=")[1].split()
+                    if v.strip()}
+            assert vals <= {0}

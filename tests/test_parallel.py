@@ -121,6 +121,44 @@ def test_voting_parallel_matches_serial_with_full_topk():
     _assert_tree_equal(ts, tp)
 
 
+def test_voting_election_uses_per_feature_max_not_sum():
+    """GlobalVoting keeps the per-feature MAX of count-weighted local gains
+    over machines, then top-k (voting_parallel_tree_learner.cpp:157-186).
+
+    Planted data, 8 shards x 64 rows, 2 features, top_k=1:
+      * feature 0: mild gain 16 on EVERY shard (sum rule would score
+        8*16=128 and elect it),
+      * feature 1: gain 64 on shard 0 only, constant elsewhere (max rule
+        scores it 64 > 16 and elects it).
+    All shard leaf counts equal mean_num_data, so the weights are the raw
+    local gains.  The root split feature therefore reveals the election
+    rule: max -> 1, sum -> 0 (0 is also the serial/global-gain choice)."""
+    n, per = 512, 64
+    g = np.zeros(n, np.float32)
+    f0 = np.zeros(n, np.int32)
+    f1 = np.zeros(n, np.int32)
+    for s in range(8):
+        lo = s * per
+        # f0: bins 0/1 halves; 24-of-32 label agreement -> G=+-16, gain 16
+        f0[lo:lo + 32] = 0
+        f0[lo + 32:lo + per] = 1
+        g[lo:lo + 24] = -1.0
+        g[lo + 24:lo + 32] = 1.0
+        g[lo + 32:lo + 56] = 1.0
+        g[lo + 56:lo + per] = -1.0
+    # f1: perfect separation on shard 0 (gain 64), constant elsewhere
+    f1[:per] = (g[:per] > 0).astype(np.int32)
+    bins = np.stack([f0, f1])
+    h = np.ones(n, np.float32)
+    params = GrowParams(num_leaves=2, max_bin=16, min_data_in_leaf=5,
+                        min_sum_hessian_in_leaf=1e-3)
+    ts, _, _ = _grow_serial(bins, g, h, params, 16)
+    assert int(ts.split_feature[0]) == 0  # global gain prefers feature 0
+    tp, _, _ = _grow_parallel("voting", bins, g, h, params, 16, top_k=1)
+    assert int(tp.num_leaves) == 2
+    assert int(tp.split_feature[0]) == 1  # max-rule election won
+
+
 def test_voting_parallel_small_topk_reasonable():
     # With top_k < F voting is approximate; the tree must still be a valid
     # gainful tree (num_leaves grown, finite leaf values).

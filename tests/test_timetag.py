@@ -23,11 +23,41 @@ def test_phase_accumulators():
         t = timetag.get_timings()
     finally:
         timetag.enable(False)
-    for phase in ("GBDT::boosting", "GBDT::tree", "GBDT::train_score",
-                  "GBDT::valid_score", "GBDT::host_tree", "GBDT::metric"):
+    # the standard path runs one fused dispatch per round: gradients +
+    # growth + train-score land in GBDT::tree (models/gbdt.py
+    # _make_train_step)
+    for phase in ("GBDT::tree", "GBDT::valid_score", "GBDT::host_tree",
+                  "GBDT::metric", "GBDT::bagging"):
         assert phase in t and t[phase] >= 0.0, (phase, t)
     timetag.reset()
     assert timetag.get_timings() == {}
+
+
+def test_phase_accumulators_custom_fobj():
+    """The custom-fobj path keeps the reference's per-phase taxonomy
+    (gradients arrive from the host, so boosting/tree/train_score are
+    separate dispatches)."""
+    rng = np.random.RandomState(4)
+    X = rng.normal(size=(300, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+
+    def fobj(preds, ds_):
+        p = 1.0 / (1.0 + np.exp(-preds))
+        return p - ds_.get_label(), p * (1 - p)
+
+    timetag.enable(True)
+    timetag.reset()
+    try:
+        ds = lgb.Dataset(X, label=y)
+        lgb.train({"objective": "none", "num_leaves": 7, "verbose": -1},
+                  ds, num_boost_round=2, fobj=fobj)
+        t = timetag.get_timings()
+    finally:
+        timetag.enable(False)
+    for phase in ("GBDT::boosting", "GBDT::tree", "GBDT::train_score",
+                  "GBDT::host_tree"):
+        assert phase in t and t[phase] >= 0.0, (phase, t)
+    timetag.reset()
 
 
 def test_disabled_is_noop():
