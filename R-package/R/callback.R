@@ -1,0 +1,63 @@
+# Training callbacks for lgb.train/lgb.cv.
+#
+# Reference surface: R-package/R/callback.R (cb.reset.parameters,
+# cb.print.evaluation, cb.record.evaluation, cb.early.stop closures run by
+# the R training loop).  In this binding the boosting loop runs inside the
+# Python engine, so each R constructor returns a TAG the training entries
+# translate into the matching Python callback (lightgbm_tpu.callback);
+# arbitrary R closures cannot run inside the Python loop and are rejected
+# with a clear message by lgb.train.
+
+cb.print.evaluation <- function(period = 1L) {
+  structure(list(kind = "print_evaluation", period = as.integer(period)),
+            class = "lgb.cb")
+}
+
+cb.record.evaluation <- function() {
+  structure(list(kind = "record_evaluation"), class = "lgb.cb")
+}
+
+cb.reset.parameters <- function(new_params) {
+  # new_params: named list; each entry either a vector of length nrounds
+  # or an R function(iter, nrounds) -> value (translated to a Python
+  # callable by reticulate)
+  structure(list(kind = "reset_parameter", new_params = new_params),
+            class = "lgb.cb")
+}
+
+cb.early.stop <- function(stopping_rounds, verbose = TRUE) {
+  structure(list(kind = "early_stopping",
+                 stopping_rounds = as.integer(stopping_rounds),
+                 verbose = verbose),
+            class = "lgb.cb")
+}
+
+# Internal: translate a list of lgb.cb tags into Python callbacks.
+# Returns list(py_callbacks, record_env) where record_env$dict is the
+# evals_result dict when cb.record.evaluation was requested.
+lgb.cb2py <- function(callbacks) {
+  lgb <- lgb.get.module()
+  cb_mod <- reticulate::import("lightgbm_tpu.callback")
+  out <- list()
+  record <- NULL
+  for (cb in callbacks) {
+    if (!inherits(cb, "lgb.cb")) {
+      stop("lgb.train: callbacks must be built by cb.print.evaluation / ",
+           "cb.record.evaluation / cb.reset.parameters / cb.early.stop; ",
+           "custom R closures cannot run inside the Python training loop")
+    }
+    if (cb$kind == "print_evaluation") {
+      out[[length(out) + 1L]] <- cb_mod$print_evaluation(cb$period)
+    } else if (cb$kind == "record_evaluation") {
+      record <- reticulate::dict()
+      out[[length(out) + 1L]] <- cb_mod$record_evaluation(record)
+    } else if (cb$kind == "reset_parameter") {
+      out[[length(out) + 1L]] <- do.call(cb_mod$reset_parameter,
+                                         cb$new_params)
+    } else if (cb$kind == "early_stopping") {
+      out[[length(out) + 1L]] <- cb_mod$early_stopping(
+        cb$stopping_rounds, verbose = cb$verbose)
+    }
+  }
+  list(py_callbacks = out, record = record)
+}

@@ -104,6 +104,11 @@ Booster <- R6::R6Class(
       reticulate::py_to_r(out)
     },
 
+    num_class = function() {
+      as.integer(reticulate::py_to_r(
+        reticulate::py_get_attr(self$py, "_booster")$num_class))
+    },
+
     feature_importance = function(importance_type = "split") {
       as.vector(reticulate::py_to_r(
         self$py$feature_importance(importance_type)))
@@ -132,12 +137,37 @@ lgb.dump <- function(booster, num_iteration = -1L) {
 }
 
 lgb.importance <- function(model, percentage = TRUE) {
+  # Reference table shape (R-package/R/lgb.importance.R): per-feature
+  # Gain / Cover / Frequency aggregated over every split, sorted by Gain,
+  # optionally normalized to proportions.
   lgb.check.r6(model, "lgb.Booster", "lgb.importance")
-  imp <- model$feature_importance()
-  if (percentage && sum(imp) > 0) {
-    imp <- imp / sum(imp)
+  td <- as.data.frame(lgb.model.dt.tree(model))
+  nodes <- td[!is.na(td$split_index), , drop = FALSE]
+  if (!nrow(nodes)) {
+    return(data.frame(Feature = character(0), Gain = numeric(0),
+                      Cover = numeric(0), Frequency = numeric(0)))
   }
-  imp
+  feats <- unique(nodes$split_feature)
+  agg <- function(fun, col) vapply(feats, function(f)
+    fun(nodes[[col]][nodes$split_feature == f]), 0.0)
+  out <- data.frame(Feature = feats,
+                    Gain = agg(sum, "split_gain"),
+                    Cover = agg(sum, "internal_count"),
+                    Frequency = vapply(feats, function(f)
+                      sum(nodes$split_feature == f), 0L),
+                    stringsAsFactors = FALSE)
+  if (percentage) {
+    for (col in c("Gain", "Cover", "Frequency")) {
+      s <- sum(out[[col]])
+      if (s > 0) out[[col]] <- out[[col]] / s
+    }
+  }
+  out <- out[order(-out$Gain), , drop = FALSE]
+  rownames(out) <- NULL
+  if (requireNamespace("data.table", quietly = TRUE)) {
+    out <- data.table::as.data.table(out)
+  }
+  out
 }
 
 lgb.get.eval.result <- function(booster, data_name, eval_name,

@@ -1,0 +1,47 @@
+# lgb.Predictor: internal prediction helper.
+#
+# Reference surface: R-package/R/lgb.Predictor.R (an R6 class owning a
+# model handle + prediction parameters, used by Booster$predict and by
+# Dataset construction with a predictor for continued training).  Here it
+# wraps the Python Booster's predict with pinned parameters.
+
+lgb.Predictor <- R6::R6Class(
+  "lgb.Predictor",
+  public = list(
+    booster = NULL,
+    num_iteration = -1L,
+    rawscore = FALSE,
+    predleaf = FALSE,
+
+    initialize = function(booster, num_iteration = -1L,
+                          rawscore = FALSE, predleaf = FALSE) {
+      if (inherits(booster, "lgb.Booster")) {
+        self$booster <- booster
+      } else if (is.character(booster)) {
+        self$booster <- lgb.load(filename = booster)
+      } else {
+        stop("lgb.Predictor: booster must be an lgb.Booster or a model ",
+             "file path")
+      }
+      self$num_iteration <- as.integer(num_iteration)
+      self$rawscore <- rawscore
+      self$predleaf <- predleaf
+      invisible(self)
+    },
+
+    current_iter = function() {
+      as.integer(self$booster$py$num_trees()) %/%
+        max(self$booster$num_class(), 1L)
+    },
+
+    predict = function(data, header = FALSE, reshape = TRUE) {
+      self$booster$predict(data, num_iteration = self$num_iteration,
+                           rawscore = self$rawscore,
+                           predleaf = self$predleaf,
+                           header = header, reshape = reshape)
+    }
+  )
+)
+
+# short internal alias (reference code and tests use Predictor$new)
+Predictor <- lgb.Predictor

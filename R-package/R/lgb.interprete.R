@@ -1,0 +1,81 @@
+# lgb.interprete: per-prediction feature contributions.
+#
+# Reference surface: R-package/R/lgb.interprete.R — for each selected row,
+# follow its leaf path root->leaf in every tree and attribute each step's
+# value change (child value - parent internal value) to the split feature;
+# sum per feature, per class for multiclass.  The path is reconstructed
+# from lgb.model.dt.tree plus predict(predleaf=TRUE).
+
+lgb.interprete <- function(model, data, idxset, num_iteration = NULL) {
+  lgb.check.r6(model, "lgb.Booster", "lgb.interprete")
+  tree_dt <- lgb.model.dt.tree(model, num_iteration)
+  tree_dt <- as.data.frame(tree_dt)
+  num_class <- model$num_class()
+  if (is.null(num_iteration)) num_iteration <- -1L
+
+  rows <- data[idxset, , drop = FALSE]
+  leaf_mat <- model$predict(rows, num_iteration = num_iteration,
+                            predleaf = TRUE)
+  leaf_mat <- matrix(as.integer(leaf_mat), nrow = nrow(rows))
+
+  # parent/value/feature lookups per tree
+  trees <- split(tree_dt, tree_dt$tree_index)
+
+  contrib_one <- function(row_i) {
+    acc <- new.env(parent = emptyenv())
+    for (t_i in seq_along(trees)) {
+      td <- trees[[t_i]]
+      tree_index <- td$tree_index[1L]
+      cls <- tree_index %% num_class
+      leaf <- leaf_mat[row_i, tree_index + 1L]
+      leaves <- td[!is.na(td$leaf_index), ]
+      nodes <- td[!is.na(td$split_index), ]
+      lrow <- leaves[leaves$leaf_index == leaf, ]
+      if (!nrow(lrow)) next
+      child_val <- lrow$leaf_value
+      parent <- lrow$leaf_parent
+      while (!is.na(parent)) {
+        prow <- nodes[nodes$split_index == parent, ]
+        if (!nrow(prow)) break
+        key <- paste0(prow$split_feature, "\r", cls)
+        delta <- child_val - prow$internal_value
+        acc[[key]] <- (if (is.null(acc[[key]])) 0 else acc[[key]]) + delta
+        child_val <- prow$internal_value
+        parent <- prow$node_parent
+      }
+    }
+    keys <- ls(acc)
+    if (!length(keys)) {
+      out <- data.frame(Feature = character(0))
+      for (k in seq_len(num_class)) out[[paste0("Contribution",
+          if (num_class > 1L) k - 1L else "")]] <- numeric(0)
+      return(out)
+    }
+    split_keys <- strsplit(keys, "\r", fixed = TRUE)
+    feats <- vapply(split_keys, `[[`, "", 1L)
+    clss <- as.integer(vapply(split_keys, `[[`, "", 2L))
+    vals <- vapply(keys, function(k) acc[[k]], 0.0)
+    feat_u <- unique(feats)
+    if (num_class == 1L) {
+      out <- data.frame(Feature = feat_u,
+                        Contribution = vapply(feat_u, function(f)
+                          sum(vals[feats == f]), 0.0),
+                        stringsAsFactors = FALSE)
+      out <- out[order(-abs(out$Contribution)), ]
+    } else {
+      out <- data.frame(Feature = feat_u, stringsAsFactors = FALSE)
+      for (k in 0:(num_class - 1L)) {
+        out[[paste0("Class ", k)]] <- vapply(feat_u, function(f)
+          sum(vals[feats == f & clss == k]), 0.0)
+      }
+      out <- out[order(-rowSums(abs(out[, -1L, drop = FALSE]))), ]
+    }
+    rownames(out) <- NULL
+    if (requireNamespace("data.table", quietly = TRUE)) {
+      out <- data.table::as.data.table(out)
+    }
+    out
+  }
+
+  lapply(seq_len(nrow(rows)), contrib_one)
+}

@@ -1,0 +1,67 @@
+# lgb.model.dt.tree: flatten a model dump into one table of nodes+leaves.
+#
+# Reference surface: R-package/R/lgb.model.dt.tree.R (jsonlite parse of
+# lgb.dump + per-tree recursive flatten).  Here the Python dump_model()
+# dict arrives through reticulate already parsed, so only the flatten
+# remains.  Returns a data.table when data.table is installed, else a
+# data.frame with the same columns.
+
+lgb.model.dt.tree <- function(model, num_iteration = NULL) {
+  lgb.check.r6(model, "lgb.Booster", "lgb.model.dt.tree")
+  if (is.null(num_iteration)) num_iteration <- -1L
+  dump <- model$dump_model(num_iteration)
+  feature_names <- unlist(dump$feature_names)
+
+  flatten_node <- function(node, tree_index, parent) {
+    if (is.null(node$split_index)) {
+      # leaf; a 1-leaf tree's root carries only leaf_value
+      return(data.frame(
+        tree_index = tree_index,
+        split_index = NA_integer_,
+        split_feature = NA_character_,
+        node_parent = NA_integer_,
+        leaf_index = if (is.null(node$leaf_index)) 0L
+                     else as.integer(node$leaf_index),
+        leaf_parent = parent,
+        split_gain = NA_real_,
+        threshold = NA_real_,
+        decision_type = NA_character_,
+        internal_value = NA_real_,
+        internal_count = NA_integer_,
+        leaf_value = as.numeric(node$leaf_value),
+        leaf_count = if (is.null(node$leaf_count)) NA_integer_
+                     else as.integer(node$leaf_count),
+        stringsAsFactors = FALSE))
+    }
+    idx <- as.integer(node$split_index)
+    row <- data.frame(
+      tree_index = tree_index,
+      split_index = idx,
+      split_feature = feature_names[as.integer(node$split_feature) + 1L],
+      node_parent = parent,
+      leaf_index = NA_integer_,
+      leaf_parent = NA_integer_,
+      split_gain = as.numeric(node$split_gain),
+      threshold = as.numeric(node$threshold),
+      decision_type = as.character(node$decision_type),
+      internal_value = as.numeric(node$internal_value),
+      internal_count = as.integer(node$internal_count),
+      leaf_value = NA_real_,
+      leaf_count = NA_integer_,
+      stringsAsFactors = FALSE)
+    rbind(row,
+          flatten_node(node$left_child, tree_index, idx),
+          flatten_node(node$right_child, tree_index, idx))
+  }
+
+  pieces <- lapply(seq_along(dump$tree_info), function(i) {
+    tree <- dump$tree_info[[i]]
+    flatten_node(tree$tree_structure, i - 1L, NA_integer_)
+  })
+  out <- do.call(rbind, pieces)
+  rownames(out) <- NULL
+  if (requireNamespace("data.table", quietly = TRUE)) {
+    out <- data.table::as.data.table(out)
+  }
+  out
+}

@@ -1,0 +1,47 @@
+# lgb.plot.importance / lgb.plot.interpretation: base-graphics barplots.
+#
+# Reference surface: R-package/R/lgb.plot.importance.R and
+# lgb.plot.interpretation.R (graphics::barplot of the importance /
+# interpretation tables, top_n rows, horizontal, labels in the margin).
+
+lgb.plot.importance <- function(tree_imp, top_n = 10, measure = "Gain",
+                                left_margin = 10, cex = NULL) {
+  tree_imp <- as.data.frame(tree_imp)
+  if (!measure %in% colnames(tree_imp)) {
+    stop("lgb.plot.importance: measure must be one of ",
+         paste(setdiff(colnames(tree_imp), "Feature"), collapse = ", "))
+  }
+  tree_imp <- tree_imp[order(-tree_imp[[measure]]), , drop = FALSE]
+  n <- min(top_n, nrow(tree_imp))
+  tree_imp <- tree_imp[seq_len(n), , drop = FALSE]
+  op <- graphics::par(mar = c(3, left_margin, 3, 1))
+  on.exit(graphics::par(op))
+  graphics::barplot(rev(tree_imp[[measure]]),
+                    names.arg = rev(tree_imp$Feature),
+                    horiz = TRUE, las = 1, cex.names = cex,
+                    main = "Feature Importance",
+                    xlab = measure, border = NA)
+  invisible(tree_imp)
+}
+
+lgb.plot.interpretation <- function(tree_interpretation_dt, top_n = 10,
+                                    cols = 1, left_margin = 10,
+                                    cex = NULL) {
+  ti <- as.data.frame(tree_interpretation_dt)
+  num_class <- ncol(ti) - 1L
+  op <- graphics::par(mar = c(3, left_margin, 3, 1),
+                      mfrow = c(ceiling(num_class / cols),
+                                min(cols, num_class)))
+  on.exit(graphics::par(op))
+  for (k in seq_len(num_class)) {
+    col <- colnames(ti)[k + 1L]
+    ord <- order(-abs(ti[[col]]))
+    sub <- ti[ord[seq_len(min(top_n, nrow(ti)))], , drop = FALSE]
+    graphics::barplot(rev(sub[[col]]), names.arg = rev(sub$Feature),
+                      horiz = TRUE, las = 1, cex.names = cex,
+                      main = if (num_class > 1L) col
+                             else "Feature Contribution",
+                      xlab = "Contribution", border = NA)
+  }
+  invisible(tree_interpretation_dt)
+}

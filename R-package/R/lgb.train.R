@@ -19,10 +19,7 @@ lgb.train <- function(params = list(), data, nrounds = 10,
                       callbacks = list(), ...) {
   lgb <- lgb.get.module()
   lgb.check.r6(data, "lgb.Dataset", "lgb.train")
-  if (length(callbacks)) {
-    stop("lgb.train: R-side callbacks are not supported by this binding; ",
-         "use the Python API for custom callbacks")
-  }
+  cb <- lgb.cb2py(callbacks)          # tags from callback.R -> Python
   params <- lgb.params2list(params, ...)
   if (!is.null(obj)) {
     params$objective <- obj
@@ -59,11 +56,16 @@ lgb.train <- function(params = list(), data, nrounds = 10,
       as.integer(early_stopping_rounds),
     evals_result = evals_result,
     verbose_eval = if (verbose > 0) as.integer(eval_freq) else FALSE,
-    init_model = init)
+    init_model = init,
+    callbacks = if (length(cb$py_callbacks)) cb$py_callbacks else NULL)
   out <- Booster$new(py_handle = py_booster)
   out$best_iter <- py_booster$best_iteration
   if (record) {
     out$record_evals <- reticulate::py_to_r(evals_result)
+  }
+  if (!is.null(cb$record)) {
+    out$record_evals <- utils::modifyList(out$record_evals,
+                                          reticulate::py_to_r(cb$record))
   }
   out
 }

@@ -1,0 +1,32 @@
+# saveRDS/readRDS wrappers: an lgb.Booster holds a live Python handle that
+# R serialization cannot capture, so the model travels as its reference
+# text format inside the RDS payload.
+#
+# Reference surface: R-package/R/saveRDS.lgb.Booster.R and
+# readRDS.lgb.Booster.R (which stash the C++ handle's raw model string the
+# same way).
+
+saveRDS.lgb.Booster <- function(object, file = "", ascii = FALSE,
+                                version = NULL, compress = TRUE,
+                                refhook = NULL) {
+  lgb.check.r6(object, "lgb.Booster", "saveRDS.lgb.Booster")
+  payload <- list(
+    lgb_booster_model_str = object$save_model_to_string(),
+    best_iter = object$best_iter,
+    record_evals = object$record_evals)
+  class(payload) <- "lgb.Booster.rds"
+  saveRDS(payload, file = file, ascii = ascii, version = version,
+          compress = compress, refhook = refhook)
+}
+
+readRDS.lgb.Booster <- function(file = "", refhook = NULL) {
+  payload <- readRDS(file = file, refhook = refhook)
+  if (!inherits(payload, "lgb.Booster.rds")) {
+    # a plain RDS: return unchanged, like the reference
+    return(payload)
+  }
+  booster <- lgb.load(model_str = payload$lgb_booster_model_str)
+  booster$best_iter <- payload$best_iter
+  booster$record_evals <- payload$record_evals
+  booster
+}

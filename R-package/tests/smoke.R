@@ -1,0 +1,60 @@
+# End-to-end R smoke test: train / predict / save / load / dump /
+# importance / model.dt.tree / interprete / RDS round-trip / callbacks.
+# Run by CI wherever an R runtime with reticulate exists:
+#
+#   Rscript R-package/tests/smoke.R
+#
+# PYTHONPATH (or an installed lightgbm_tpu) must expose the Python core.
+
+for (f in list.files("R-package/R", full.names = TRUE)) source(f)
+
+set.seed(1)
+n <- 600
+X <- matrix(rnorm(n * 5), ncol = 5)
+colnames(X) <- paste0("f", 1:5)
+y <- as.numeric(X[, 1] + 0.5 * X[, 2] > 0)
+
+ds <- lgb.Dataset(X, info = list(label = y))
+bst <- lgb.train(list(objective = "binary", num_leaves = 7,
+                      min_data_in_leaf = 20, verbose = -1),
+                 data = ds, nrounds = 10,
+                 callbacks = list(cb.record.evaluation()))
+stopifnot(inherits(bst, "lgb.Booster"))
+
+p <- bst$predict(X)
+stopifnot(length(p) == n, all(is.finite(p)))
+auc_ok <- mean((p > 0.5) == y) > 0.8
+stopifnot(auc_ok)
+
+# save / load round-trip
+f_model <- tempfile(fileext = ".txt")
+lgb.save(bst, f_model)
+bst2 <- lgb.load(filename = f_model)
+stopifnot(max(abs(bst2$predict(X) - p)) < 1e-10)
+
+# dump + tree table + importance
+dump <- bst$dump_model()
+stopifnot(length(dump$tree_info) == 10)
+tree_dt <- lgb.model.dt.tree(bst)
+stopifnot(nrow(tree_dt) > 10, "split_feature" %in% colnames(tree_dt))
+imp <- lgb.importance(bst)
+stopifnot(nrow(imp) >= 1)
+
+# interpretation of 3 rows
+contrib <- lgb.interprete(bst, X, 1:3)
+stopifnot(length(contrib) == 3,
+          all(vapply(contrib, function(d) "Feature" %in% colnames(d),
+                     TRUE)))
+
+# RDS round-trip
+f_rds <- tempfile(fileext = ".rds")
+saveRDS.lgb.Booster(bst, f_rds)
+bst3 <- readRDS.lgb.Booster(f_rds)
+stopifnot(max(abs(bst3$predict(X) - p)) < 1e-10)
+
+# Predictor + leaf indices
+pred <- Predictor$new(bst, predleaf = TRUE)
+leaves <- pred$predict(X[1:4, , drop = FALSE])
+stopifnot(nrow(leaves) == 4)
+
+cat("R-SMOKE-OK\n")

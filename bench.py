@@ -55,6 +55,14 @@ def main() -> None:
     num_timed = int(os.environ.get("BENCH_ITERS", 30))
 
     import jax
+    # persistent XLA compilation cache: the grow program compiles in
+    # minutes on the remote AOT service; repeat runs (and the driver's
+    # bench run after any local run) hit the cache instead.
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                       "/tmp/lightgbm_tpu_jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.io.dataset import BinnedDataset
     from lightgbm_tpu.models.gbdt import GBDT

@@ -27,6 +27,10 @@ from .utils import log
 
 def run_train(config: Config, params: Dict[str, str]) -> None:
     """Application::InitTrain + Train (application.cpp:187-240)."""
+    # reference Network::Init from machine_list_file (application.cpp:70):
+    # multi-machine confs bring up jax.distributed before any device use
+    from .parallel.multihost import maybe_initialize_distributed
+    maybe_initialize_distributed(config)
     data_path = config.data
     if not data_path:
         log.fatal("No training data specified (data=...)")

@@ -9,7 +9,9 @@ device during training; metrics pull them once per eval.
 
 from __future__ import annotations
 
+import functools
 import io
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -74,6 +76,21 @@ class _DeviceData:
         self.score = self.score.at[cls].add(delta)
 
 
+@functools.partial(jax.jit, static_argnames=("n", "bag_cnt"))
+def _device_bag_mask(key, n: int, bag_cnt: int):
+    """Exact-count sample without replacement: kth order statistic of
+    per-row uniforms as the keep threshold (count can differ from
+    bag_cnt only on float ties, which jax.random.uniform makes
+    vanishingly rare)."""
+    if bag_cnt <= 0:
+        # matches the host-draw degenerate case (reference bag_data_cnt=0
+        # keeps nothing); the wrapped [-1] index would keep EVERYTHING
+        return jnp.zeros((n,), jnp.float32)
+    r = jax.random.uniform(key, (n,))
+    thr = jnp.sort(r)[bag_cnt - 1]
+    return (r <= thr).astype(jnp.float32)
+
+
 class GBDT:
     """Gradient Boosting Decision Tree (reference gbdt.h:20-351).
 
@@ -130,7 +147,7 @@ class GBDT:
         self.valid_metrics: List[List[Metric]] = []
         self.train_metrics = self._make_metrics(cfg, train_set)
 
-        self._bagging_rng = np.random.RandomState(cfg.bagging_seed)
+        self._bag_key = jax.random.PRNGKey(cfg.bagging_seed)
         self._feature_rng = np.random.RandomState(cfg.feature_fraction_seed)
         self._row_weight = jnp.ones(self.num_data, jnp.float32)
         self._grad_fn = jax.jit(self.objective.gradients)
@@ -145,13 +162,24 @@ class GBDT:
 
     @staticmethod
     def _make_grow_params(cfg: Config) -> GrowParams:
+        # bagging / GOSS produce zero-weight rows every round: compact
+        # them out of the leaf-ordered layout so tree cost tracks the
+        # subsample (gbdt.cpp:271-278's bag-subset dataset switch).
+        # GOSS qualifies only when it can actually sample (top+other < 1);
+        # its 1/learning_rate warmup rounds still pay the compaction sort
+        # on an all-active mask — accepted, the steady state dominates.
+        goss_samples = (cfg.boosting_type == "goss"
+                        and (cfg.top_rate + cfg.other_rate) < 1.0)
+        subsampled = (goss_samples
+                      or (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0))
         return GrowParams(
             num_leaves=cfg.num_leaves, max_bin=cfg.max_bin,
             min_data_in_leaf=cfg.min_data_in_leaf,
             min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
             lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
             min_gain_to_split=cfg.min_gain_to_split,
-            max_depth=cfg.max_depth)
+            max_depth=cfg.max_depth,
+            compact_inactive=subsampled)
 
     @staticmethod
     def _make_metrics(cfg: Config, dataset: BinnedDataset) -> List[Metric]:
@@ -172,7 +200,13 @@ class GBDT:
         cfg = self.config
         if getattr(cfg, "is_parallel", False):
             ndev = len(jax.devices())
-            k = min(cfg.num_machines, ndev)
+            # single-controller-per-host: num_machines counts HOSTS (the
+            # reference's machine list, wired up by parallel/multihost.py);
+            # under a multi-process runtime the mesh spans every global
+            # device.  In one process it bounds the local mesh instead
+            # (the virtual-device test rigs).
+            k = ndev if jax.process_count() > 1 \
+                else min(cfg.num_machines, ndev)
             if k > 1:
                 from jax.sharding import Mesh
                 from ..parallel import make_parallel_grow
@@ -289,17 +323,19 @@ class GBDT:
     # ------------------------------------------------------------------
     def _bagging_mask(self, iter_: int) -> jax.Array:
         """Bagging (gbdt.cpp:201-280): pick bagging_fraction*N rows without
-        replacement every bagging_freq iterations."""
+        replacement every bagging_freq iterations.
+
+        The draw runs ON DEVICE (uniforms + order-statistic threshold):
+        a host-side np.random.choice without replacement at 1M rows costs
+        tens of ms plus a 4 MB upload EVERY round at bagging_freq=1 —
+        more than the tree it was supposed to shrink."""
         cfg = self.config
         if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
             return jnp.ones(self.num_data, jnp.float32)
         if iter_ % cfg.bagging_freq == 0:
             bag_cnt = int(cfg.bagging_fraction * self.num_data)
-            idx = self._bagging_rng.choice(self.num_data, bag_cnt,
-                                           replace=False)
-            mask = np.zeros(self.num_data, np.float32)
-            mask[idx] = 1.0
-            self._row_weight = jnp.asarray(mask)
+            self._bag_key, sub = jax.random.split(self._bag_key)
+            self._row_weight = _device_bag_mask(sub, self.num_data, bag_cnt)
         return self._row_weight
 
     def _feature_mask(self) -> jax.Array:
@@ -416,9 +452,16 @@ class GBDT:
             # dispatching — and clear it so a later retry trains afresh
             self._no_more_splits = False
             return True
-        fused = grad is None and hess is None
-        with timetag.scope("GBDT::bagging"):
-            row_weight = self._bagging_mask(self.iter_)
+        # The fused step computes gradients INSIDE the jit, so it only
+        # applies when this instance uses the plain objective pass —
+        # subclasses overriding _gradients with host-side work per round
+        # (GOSS sampling/amplification, custom boosters) must take the
+        # per-stage path.  LGBT_NO_FUSED_STEP=1/true also forces it (same
+        # results; smaller XLA programs for compile-constrained setups).
+        fused = (grad is None and hess is None
+                 and type(self)._gradients is GBDT._gradients
+                 and os.environ.get("LGBT_NO_FUSED_STEP", "").lower()
+                 not in ("1", "true", "yes"))
         if self._lr_cache[0] != self.shrinkage_rate:
             self._lr_cache = (self.shrinkage_rate,
                               jnp.float32(self.shrinkage_rate))
@@ -426,6 +469,8 @@ class GBDT:
         cur = []
         if fused:
             # standard objective: ONE device dispatch for the whole round
+            with timetag.scope("GBDT::bagging"):
+                row_weight = self._bagging_mask(self.iter_)
             if self._train_step is None:
                 self._train_step = self._make_train_step()
             feat_masks = self._feature_masks_all()
@@ -443,14 +488,21 @@ class GBDT:
                     tt.sync(vdeltas)
                 cur.append((packed, delta, vdeltas))
         else:
-            # custom fobj path (engine.train(fobj=...), C API boosters):
-            # gradients arrive from the host, dispatch per class
+            # per-stage path: custom fobj, GOSS-style _gradients hooks, or
+            # LGBT_NO_FUSED_STEP.  Gradients BEFORE the bagging mask:
+            # GOSS._gradients draws this round's sample and the mask read
+            # must see it (gbdt.cpp Bagging-before-Boosting ordering).
             with timetag.scope("GBDT::boosting") as tt:
-                grad = jnp.asarray(grad, jnp.float32).reshape(
-                    self.num_class, -1)
-                hess = jnp.asarray(hess, jnp.float32).reshape(
-                    self.num_class, -1)
+                if grad is None or hess is None:
+                    grad, hess = self._gradients()
+                else:
+                    grad = jnp.asarray(grad, jnp.float32).reshape(
+                        self.num_class, -1)
+                    hess = jnp.asarray(hess, jnp.float32).reshape(
+                        self.num_class, -1)
                 tt.sync((grad, hess))
+            with timetag.scope("GBDT::bagging"):
+                row_weight = self._bagging_mask(self.iter_)
             for cls in range(self.num_class):
                 feat_mask = self._feature_mask()
                 with timetag.scope("GBDT::tree") as tt:

@@ -172,6 +172,12 @@ class GrowParams(NamedTuple):
     lambda_l2: float = 0.0
     min_gain_to_split: float = 0.0
     max_depth: int = -1
+    # bagging/GOSS: physically move zero-weight rows behind the active
+    # segment once per tree so every window/sort/histogram cost tracks the
+    # SUBSAMPLE, not N (gbdt.cpp:271-278's smaller-dataset switch); their
+    # score deltas come from a tree walk like the reference's out-of-bag
+    # AddPredictionToScore.  Only the leaf-ordered grower honors it.
+    compact_inactive: bool = False
 
     def split_params(self) -> SplitParams:
         return SplitParams(self.min_data_in_leaf, self.min_sum_hessian_in_leaf,

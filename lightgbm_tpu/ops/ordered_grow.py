@@ -68,7 +68,12 @@ _ND = dict(feature=0, bin=1, gain=2, left=3, right=4, value=5, count=6)
 
 
 def _size_classes(n: int, smallest: int = 8192):
-    """Power-of-two window classes covering [1, n]."""
+    """Power-of-two window classes covering [1, n].
+
+    A x4-spaced ladder was tried for compile time and REVERTED: it saved
+    no measurable warmup (remote-compile latency dominates and is now
+    hidden by the persistent compilation cache, bench.py) but cost ~5%
+    throughput in sort padding (docs/BENCH_NOTES_r03.md)."""
     out = []
     s = smallest
     while s < n:
@@ -153,13 +158,68 @@ def grow_tree_ordered(bins, num_bin, is_cat, feat_mask, grad, hess,
     bins_w = tuple(bw if bw.shape[0] >= N + PAD
                    else jnp.pad(bw, (0, N + PAD - bw.shape[0]))
                    for bw in bins_words)
+    root_cnt = jnp.int32(N)
     dig_w = tuple(jnp.pad(dw, (0, PAD)) for dw in pack_u8_words(
         jax.lax.bitcast_convert_type(digits, jnp.uint8)))
     DW = len(dig_w)
     row_ord = jnp.pad(jnp.arange(N, dtype=jnp.int32), (0, PAD))
 
-    # root histogram over the initial (original-order) layout
-    sums_root = leafhist.digit_histogram(bins_rm, digits, B)
+    if params.compact_inactive:
+        # one stable sort per tree (over the REAL N rows only — the
+        # window pad stays put) moves zero-weight rows behind the active
+        # segment: every later window, partition sort, and histogram then
+        # costs O(subsample), not O(N) — the reference's bag-subset
+        # dataset switch (gbdt.cpp:271-278)
+        bag_key = (row_weight <= 0.0).astype(jnp.uint8)
+        ops0 = (bag_key,) + tuple(w[:N] for w in bins_w) \
+            + tuple(w[:N] for w in dig_w) + (row_ord[:N],)
+        sorted0 = jax.lax.sort(ops0, num_keys=1, is_stable=True)
+
+        def _splice(full, head):
+            return jax.lax.dynamic_update_slice(full, head, (0,))
+        bins_w = tuple(_splice(f, h)
+                       for f, h in zip(bins_w, sorted0[1:1 + W]))
+        dig_w = tuple(_splice(f, h)
+                      for f, h in zip(dig_w, sorted0[1 + W:1 + W + DW]))
+        row_ord = _splice(row_ord, sorted0[-1])
+        root_cnt = jnp.sum((row_weight > 0.0).astype(jnp.int32))
+
+    def hist_window(bw_tuple, dw_tuple, off, scnt, Psz: int):
+        """[F, 9, B] digit sums over rows [off, off+Psz) of the packed
+        layout, digit streams masked to the first scnt rows.  The ONE
+        histogram formulation every call site shares (per-split child
+        windows and the compacted root)."""
+        ch_bins = _unpack_words(
+            tuple(jax.lax.dynamic_slice(bw, (off,), (Psz,))
+                  for bw in bw_tuple), F)
+        ch_dig = jax.lax.bitcast_convert_type(
+            jax.lax.bitcast_convert_type(
+                jnp.stack(
+                    tuple(jax.lax.dynamic_slice(dw, (off,), (Psz,))
+                          for dw in dw_tuple), axis=1),
+                jnp.uint8).reshape(Psz, -1)[:, :9], jnp.int8)
+        ch_dig = jnp.where(
+            jnp.arange(Psz, dtype=jnp.int32)[:, None] < scnt, ch_dig, 0)
+        if leafhist._on_tpu():
+            return leafhist.digit_histogram_pallas(ch_bins, ch_dig, B)
+        return leafhist.digit_histogram_scatter(ch_bins, ch_dig, B)
+
+    def windowed_hist(off, scnt):
+        """hist_window at the size class covering scnt (used by the
+        compacted root pass)."""
+        hbs = [(lambda P: (lambda args: hist_window(
+            bins_w, dig_w, args[0], args[1], P)))(P) for P in classes]
+        cls = jnp.minimum(jnp.sum(scnt > jnp.asarray(classes, jnp.int32))
+                          .astype(jnp.int32), len(hbs) - 1)
+        return jax.lax.switch(cls, hbs, (off, scnt))
+
+    if params.compact_inactive:
+        # root histogram over the compacted ACTIVE prefix: cost tracks
+        # the subsample (inactive rows have zero digits either way)
+        sums_root = windowed_hist(jnp.int32(0), root_cnt)
+    else:
+        # root histogram over the initial (original-order) layout
+        sums_root = leafhist.digit_histogram(bins_rm, digits, B)
     hist_root = leafhist.combine_digit_sums(sums_root, scales)
     root_split = find_best_split(hist_root, root_g, root_h, root_c,
                                  num_bin, is_cat, feat_mask,
@@ -175,7 +235,7 @@ def grow_tree_ordered(bins, num_bin, is_cat, feat_mask, grad, hess,
     root_i32 = jnp.array([0, 0, -1, 0, 0, 0, 0, 0], jnp.int32) \
         .at[_LI["best_feat"]].set(root_split.feature) \
         .at[_LI["best_bin"]].set(root_split.threshold) \
-        .at[_LI["cnt"]].set(N)
+        .at[_LI["cnt"]].set(root_cnt)
     leaf_i32 = jnp.zeros((L, 8), jnp.int32) \
         .at[:, _LI["parent"]].set(-1).at[0].set(root_i32)
     empty_node = jnp.zeros((8,), jnp.int32).at[_ND["feature"]].set(-1)
@@ -233,26 +293,8 @@ def grow_tree_ordered(bins, num_bin, is_cat, feat_mask, grad, hess,
             scnt = jnp.minimum(cnt_l, cnt_r)
 
             def hist_at(Psz):
-                def h(_):
-                    ch_bins = _unpack_words(
-                        tuple(jax.lax.dynamic_slice(bw, (off,), (Psz,))
-                              for bw in bins_w), F)
-                    ch_dig = jax.lax.bitcast_convert_type(
-                        jax.lax.bitcast_convert_type(
-                            jnp.stack(
-                                tuple(jax.lax.dynamic_slice(
-                                    dw, (off,), (Psz,)) for dw in dig_w),
-                                axis=1),
-                            jnp.uint8).reshape(Psz, -1)[:, :9], jnp.int8)
-                    ch_dig = jnp.where(
-                        jnp.arange(Psz, dtype=jnp.int32)[:, None] < scnt,
-                        ch_dig, 0)
-                    if leafhist._on_tpu():
-                        return leafhist.digit_histogram_pallas(ch_bins,
-                                                               ch_dig, B)
-                    return leafhist.digit_histogram_scatter(ch_bins,
-                                                            ch_dig, B)
-                return h
+                # NOTE: closes over the branch's SORTED bins_w/dig_w
+                return lambda _: hist_window(bins_w, dig_w, off, scnt, Psz)
 
             P2 = max(P // 2, classes[0] // 2, 4096)
             P8 = max(P // 8, 4096)
@@ -407,4 +449,18 @@ def grow_tree_ordered(bins, num_bin, is_cat, feat_mask, grad, hess,
     leaf_id = jnp.zeros(N, jnp.int32).at[row_ord[:N]].set(
         leaf_of_pos, unique_indices=True)
     output_delta = shrunk[leaf_id]
+
+    if params.compact_inactive:
+        # zero-weight rows never entered a segment: route them through the
+        # tree like the reference's out-of-bag AddPredictionToScore
+        # (gbdt.cpp UpdateScore; cost ~ actual tree depth via the while
+        # walk in ops/predict.py)
+        from .predict import predict_binned_tree
+        pval, pleaf = predict_binned_tree(
+            tree.split_feature, tree.split_bin,
+            is_cat[jnp.maximum(tree.split_feature, 0)],
+            tree.left_child, tree.right_child, shrunk, bins, L)
+        active = row_weight > 0.0
+        leaf_id = jnp.where(active, leaf_id, pleaf)
+        output_delta = jnp.where(active, output_delta, pval)
     return tree, leaf_id, output_delta

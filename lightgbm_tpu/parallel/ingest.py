@@ -7,7 +7,8 @@ across machines, and two collectives make every machine agree on the full
 mapper set before local rows are binned.
 
 TPU-native formulation (single-controller JAX; the same code runs
-per-process under multi-host jax.distributed):
+per-process under multi-host jax.distributed — brought up from reference
+machine_list_file confs by parallel/multihost.py):
 
 1. *Deterministic global sample*: sample row indices are drawn from the
    GLOBAL row count with the same seed/order as the single-host path

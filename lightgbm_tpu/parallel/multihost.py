@@ -1,0 +1,115 @@
+"""Multi-host bring-up: the reference's machine-list discovery mapped to
+``jax.distributed.initialize``.
+
+Reference flow (src/network/linkers_socket.cpp Construct + config
+machine_list_file): every machine reads the same ``ip port`` list, finds
+its own entry, listens on its port, and connects to the others.  The JAX
+runtime replaces the TCP linkers/Bruck topology wholesale (SURVEY §2.3):
+all that remains is electing a coordinator and numbering the processes,
+which this module derives from the SAME machine list file so reference
+multi-machine confs run unmodified:
+
+  * coordinator = first list entry (host:port),
+  * process_id  = this machine's index in the list, located by matching
+    local interface addresses/hostname (override:
+    LIGHTGBM_TPU_PROCESS_ID=<idx> for containerized setups where the
+    list names VIPs the host cannot see).
+
+After ``jax.distributed.initialize`` the existing device-mesh learners
+(parallel/comm.py) and the sharded ingestion (parallel/ingest.py) operate
+per-process on the global device set with no further changes — the mesh
+axis simply spans hosts, and the psum/all_gather collectives ride
+ICI/DCN as laid out by XLA.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import List, Optional, Tuple
+
+from ..utils import log
+
+
+def parse_machine_list(path: str) -> List[Tuple[str, int]]:
+    """``ip port`` per line (config.h machine_list_file format)."""
+    out: List[Tuple[str, int]] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.replace(",", " ").split()
+            if len(parts) < 2:
+                log.fatal("machine_list_file: malformed line %r", line)
+            out.append((parts[0], int(parts[1])))
+    return out
+
+
+def _local_addresses() -> set:
+    names = {socket.gethostname()}
+    try:
+        names.add(socket.getfqdn())
+        for info in socket.getaddrinfo(socket.gethostname(), None):
+            names.add(info[4][0])
+    except OSError:
+        pass
+    names.update({"127.0.0.1", "localhost"})
+    return names
+
+def find_process_id(machines: List[Tuple[str, int]]) -> Optional[int]:
+    """This host's rank in the machine list (linkers_socket.cpp's
+    own-entry search), or None when no entry matches."""
+    override = os.environ.get("LIGHTGBM_TPU_PROCESS_ID")
+    if override is not None:
+        return int(override)
+    local = _local_addresses()
+    for i, (host, _) in enumerate(machines):
+        if host in local:
+            return i
+    return None
+
+
+def maybe_initialize_distributed(config) -> bool:
+    """Bring up jax.distributed from reference multi-machine config keys.
+
+    Returns True when a multi-host runtime was initialized (or already
+    was); False for the single-process case.  Mirrors Network::Init
+    being a no-op for num_machines <= 1."""
+    num_machines = int(getattr(config, "num_machines", 1) or 1)
+    mlist = getattr(config, "machine_list_file", "") or ""
+    if num_machines <= 1 or not mlist:
+        return False
+    import jax
+    # NOTE: must not touch jax.process_count()/jax.devices() here — any
+    # backend-initializing call makes a later distributed.initialize()
+    # illegal.  The launcher-already-initialized case is read from the
+    # distributed service state directly.
+    try:
+        from jax._src import distributed as _dist
+        if getattr(_dist.global_state, "coordinator_address", None):
+            return True  # already initialized by the launcher
+    except Exception:  # pragma: no cover - private-API drift
+        pass
+    machines = parse_machine_list(mlist)
+    if len(machines) < num_machines:
+        log.fatal("machine_list_file has %d entries but num_machines=%d",
+                  len(machines), num_machines)
+    machines = machines[:num_machines]
+    pid = find_process_id(machines)
+    if pid is None:
+        log.fatal("Could not find the local machine in machine_list_file; "
+                  "set LIGHTGBM_TPU_PROCESS_ID explicitly")
+    host, port = machines[0]
+    log.info("jax.distributed: coordinator %s:%d, process %d/%d",
+             host, port, pid, num_machines)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=f"{host}:{port}",
+            num_processes=num_machines, process_id=pid)
+    except RuntimeError as e:
+        if "already" in str(e) or "must be called before" in str(e):
+            log.warning("jax.distributed.initialize skipped: %s", e)
+            return True
+        raise
+    return True

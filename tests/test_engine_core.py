@@ -123,6 +123,28 @@ def test_dart_goss_learn():
         assert b.eval_metrics()["training"]["auc"] > 0.9, bt
 
 
+def test_goss_sampling_actually_runs():
+    """Regression (round-3 review): the fused train step must not bypass
+    GOSS._gradients — after the warmup period the row mask must be a real
+    top-gradient subsample (some zero weights, amplified small-gradient
+    rows), not all ones."""
+    import numpy as np
+    X, y = _make_synthetic_binary(n=2000)
+    # GOSS warmup lasts 1/learning_rate rounds: lr=0.5 -> sampling from
+    # round 2 on
+    b = _train({"objective": "binary", "boosting_type": "goss",
+                "top_rate": 0.2, "other_rate": 0.1, "num_leaves": 7,
+                "num_iterations": 8, "min_data_in_leaf": 20,
+                "max_bin": 63, "learning_rate": 0.5}, X, y)
+    w = np.asarray(b._row_weight)
+    kept = np.count_nonzero(w)
+    assert kept < len(w), "GOSS never sampled: row weights are all ones"
+    # kept fraction ~ top_rate + other_rate (amplification rides the
+    # gradients, so the mask itself is 0/1)
+    assert kept <= int(0.45 * len(w))
+    assert kept >= int(0.15 * len(w))
+
+
 def test_multiclass_quality():
     rng = np.random.RandomState(3)
     n = 3000

@@ -1,0 +1,51 @@
+"""Machine-list discovery for multi-host bring-up (parallel/multihost.py):
+the parsing + rank-election logic the reference implements in
+linkers_socket.cpp Construct, minus the actual TCP (jax.distributed owns
+transport).  Real multi-process init cannot run in one test process; the
+single-process no-op contract is pinned instead."""
+
+import os
+
+import pytest
+
+from lightgbm_tpu.basic import LightGBMError
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.parallel.multihost import (find_process_id,
+                                             maybe_initialize_distributed,
+                                             parse_machine_list)
+
+
+def test_parse_machine_list(tmp_path):
+    p = tmp_path / "mlist.txt"
+    p.write_text("# cluster\n10.0.0.1 12400\n10.0.0.2,12401\n\n"
+                 "worker-3 12402\n")
+    assert parse_machine_list(str(p)) == [
+        ("10.0.0.1", 12400), ("10.0.0.2", 12401), ("worker-3", 12402)]
+
+
+def test_parse_machine_list_malformed(tmp_path):
+    p = tmp_path / "mlist.txt"
+    p.write_text("10.0.0.1\n")
+    with pytest.raises(LightGBMError):
+        parse_machine_list(str(p))
+
+
+def test_find_process_id_env_override(monkeypatch):
+    monkeypatch.setenv("LIGHTGBM_TPU_PROCESS_ID", "2")
+    assert find_process_id([("a", 1), ("b", 2), ("c", 3)]) == 2
+
+
+def test_find_process_id_localhost():
+    # 127.0.0.1 always matches a local address
+    machines = [("10.99.0.1", 12400), ("127.0.0.1", 12401)]
+    assert find_process_id(machines) == 1
+    assert find_process_id([("10.99.0.1", 12400)]) is None
+
+
+def test_single_process_is_noop():
+    cfg = Config({"task": "train", "objective": "binary"})
+    assert maybe_initialize_distributed(cfg) is False
+    cfg2 = Config({"task": "train", "objective": "binary",
+                   "num_machines": 4, "tree_learner": "data"})
+    # num_machines > 1 but no machine list: local-mesh mode, no init
+    assert maybe_initialize_distributed(cfg2) is False

@@ -81,6 +81,35 @@ def test_ordered_with_bagging_weights():
                                   np.asarray(leaf_ref))
 
 
+def test_compact_inactive_matches_riding():
+    """compact_inactive=True (bagging compaction, gbdt.cpp:271-278) must
+    produce the identical tree AND identical leaf routing / deltas for
+    EVERY row — active rows via segments, zero-weight rows via the
+    out-of-bag tree walk."""
+    bins, num_bin, is_cat, feat_mask, g, h, w = _data(n=9000, cat_feature=True)
+    rng = np.random.RandomState(5)
+    w = jnp.asarray((rng.uniform(size=9000) < 0.35).astype(np.float32))
+    base = GrowParams(num_leaves=15, max_bin=32, min_data_in_leaf=20,
+                      min_sum_hessian_in_leaf=1.0)
+    bins_rm = jnp.asarray(np.ascontiguousarray(np.asarray(bins).T))
+    lr = jnp.float32(0.1)
+    t_ref, leaf_ref, delta_ref = grow_tree_ordered(
+        bins, num_bin, is_cat, feat_mask, g, h, w, lr, base,
+        bins_rm=bins_rm)
+    t_cmp, leaf_cmp, delta_cmp = grow_tree_ordered(
+        bins, num_bin, is_cat, feat_mask, g, h, w, lr,
+        base._replace(compact_inactive=True), bins_rm=bins_rm)
+    assert int(t_cmp.num_leaves) == int(t_ref.num_leaves)
+    for field in ("split_feature", "split_bin", "left_child", "right_child",
+                  "leaf_count", "leaf_parent", "leaf_depth"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(t_cmp, field)),
+            np.asarray(getattr(t_ref, field)), err_msg=field)
+    np.testing.assert_array_equal(np.asarray(leaf_cmp), np.asarray(leaf_ref))
+    np.testing.assert_allclose(np.asarray(delta_cmp), np.asarray(delta_ref),
+                               rtol=1e-6, atol=1e-7)
+
+
 def test_ordered_saturation_stops():
     bins, num_bin, is_cat, feat_mask, g, h, w = _data(n=512)
     params = GrowParams(num_leaves=31, max_bin=32, min_data_in_leaf=300,

@@ -1,0 +1,118 @@
+"""Opt-in ON-DEVICE Pallas kernel gate (VERDICT round-2 weak #5): the
+Mosaic-compiled kernels are otherwise exercised only through bench.py's
+end-to-end AUC; this runs them against the scatter references on a real
+TPU.
+
+    LGBT_TPU_KERNELS=1 python -m pytest tests/test_tpu_kernels.py -q
+
+Must run WITHOUT tests/conftest.py's CPU forcing, so this module restores
+the TPU platform when the gate env var is set (the conftest override only
+applies to the default run)."""
+
+import os
+
+import numpy as np
+import pytest
+
+_GATE = bool(os.environ.get("LGBT_TPU_KERNELS"))
+
+if _GATE:
+    os.environ["JAX_PLATFORMS"] = os.environ.get("LGBT_TPU_PLATFORM", "")
+    import jax
+    if os.environ["JAX_PLATFORMS"] == "":
+        del os.environ["JAX_PLATFORMS"]
+    jax.config.update("jax_platforms", None)
+
+pytestmark = pytest.mark.skipif(
+    not _GATE, reason="on-TPU kernel gate is opt-in (LGBT_TPU_KERNELS=1)")
+
+
+def _require_tpu():
+    import jax
+    if jax.devices()[0].platform != "tpu":
+        pytest.skip("no TPU device available")
+
+
+def test_digit_histogram_mosaic_matches_scatter():
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops import leafhist as lh
+
+    _require_tpu()
+    rng = np.random.RandomState(0)
+    n, f, b = 100_000, 28, 255
+    bins = jnp.asarray(rng.randint(0, b, size=(n, f)), jnp.uint8)
+    g = jnp.asarray(rng.normal(size=n), jnp.float32)
+    h = jnp.abs(g) + 0.1
+    w = jnp.asarray((rng.uniform(size=n) < 0.8), jnp.float32)
+    scales = lh.compute_scales(g, h, w)
+    digits = lh.quantize_digits(g, h, w, scales)
+    got = np.asarray(lh.digit_histogram_pallas(bins, digits, b))
+    want = np.asarray(lh.digit_histogram_scatter(bins, digits, b))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_children_histograms_mosaic_matches_reference():
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.histogram import build_children_histograms
+    from lightgbm_tpu.ops.pallas_histogram import children_histograms_pallas
+
+    _require_tpu()
+    rng = np.random.RandomState(1)
+    n, f, b = 50_000, 8, 64
+    bins = jnp.asarray(rng.randint(0, b, size=(f, n)), jnp.int32)
+    g = jnp.asarray(rng.normal(size=n), jnp.float32)
+    h = jnp.abs(g) + 0.1
+    w = jnp.ones(n, jnp.float32)
+    leaf = jnp.asarray(rng.randint(0, 5, size=n), jnp.int32)
+    want = np.asarray(build_children_histograms(bins, g, h, w, leaf, 1, 3, b))
+    got = np.asarray(children_histograms_pallas(bins, g, h, w, leaf, 1, 3, b))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-3)
+
+
+def test_ordered_grower_on_device_matches_cpu_reference():
+    """One full tree grown on the TPU must match the CPU-grown tree: the
+    Mosaic kernel + segment sorts + packed bookkeeping, end to end."""
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.grow import GrowParams
+    from lightgbm_tpu.ops.ordered_grow import grow_tree_ordered
+
+    _require_tpu()
+    rng = np.random.RandomState(2)
+    n, f, b = 60_000, 10, 64
+    bins_rm = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    g = rng.normal(size=n).astype(np.float32)
+    h = (np.abs(rng.normal(size=n)) + 0.1).astype(np.float32)
+    params = GrowParams(num_leaves=31, max_bin=b, min_data_in_leaf=20,
+                        min_sum_hessian_in_leaf=1.0)
+
+    def run(device, force_scatter):
+        from lightgbm_tpu.ops import leafhist
+        orig = leafhist._on_tpu
+        if force_scatter:
+            # the platform dispatcher is process-global; the CPU reference
+            # run must take the scatter path explicitly
+            leafhist._on_tpu = lambda: False
+        try:
+            with jax.default_device(device):
+                t, leaf, delta = grow_tree_ordered(
+                    jnp.asarray(bins_rm.T), jnp.full((f,), b, jnp.int32),
+                    jnp.zeros((f,), bool), jnp.ones((f,), bool),
+                    jnp.asarray(g), jnp.asarray(h),
+                    jnp.ones((n,), jnp.float32),
+                    jnp.float32(0.1), params, bins_rm=jnp.asarray(bins_rm))
+                return (np.asarray(t.split_feature),
+                        np.asarray(t.split_bin),
+                        np.asarray(leaf), np.asarray(delta))
+        finally:
+            leafhist._on_tpu = orig
+
+    tpu_out = run(jax.devices("tpu")[0], force_scatter=False)
+    cpu_out = run(jax.devices("cpu")[0], force_scatter=True)
+    np.testing.assert_array_equal(tpu_out[0], cpu_out[0])
+    np.testing.assert_array_equal(tpu_out[1], cpu_out[1])
+    np.testing.assert_array_equal(tpu_out[2], cpu_out[2])
+    # identical splits and routing; leaf VALUES round differently in f32
+    # across backends (measured <= 1e-4 relative on <0.1% of rows)
+    np.testing.assert_allclose(tpu_out[3], cpu_out[3], rtol=2e-4, atol=1e-6)
