@@ -18,9 +18,10 @@ cb.record.evaluation <- function() {
 }
 
 cb.reset.parameters <- function(new_params) {
-  # new_params: named list; each entry either a vector of length nrounds
-  # or an R function(iter, nrounds) -> value (translated to a Python
-  # callable by reticulate)
+  # new_params: named list; each entry either a numeric vector of length
+  # nrounds or an R function(iter) -> value called with the 0-based
+  # round index (the Python engine's reset_parameter contract,
+  # lightgbm_tpu/callback.py)
   structure(list(kind = "reset_parameter", new_params = new_params),
             class = "lgb.cb")
 }
@@ -52,8 +53,11 @@ lgb.cb2py <- function(callbacks) {
       record <- reticulate::dict()
       out[[length(out) + 1L]] <- cb_mod$record_evaluation(record)
     } else if (cb$kind == "reset_parameter") {
-      out[[length(out) + 1L]] <- do.call(cb_mod$reset_parameter,
-                                         cb$new_params)
+      # length-1 numeric vectors convert to Python scalars; force lists
+      # so the Python side always sees a schedule sequence
+      vals <- lapply(cb$new_params, function(v)
+        if (is.numeric(v)) as.list(v) else v)
+      out[[length(out) + 1L]] <- do.call(cb_mod$reset_parameter, vals)
     } else if (cb$kind == "early_stopping") {
       out[[length(out) + 1L]] <- cb_mod$early_stopping(
         cb$stopping_rounds, verbose = cb$verbose)

@@ -12,10 +12,15 @@ lgb.model.dt.tree <- function(model, num_iteration = NULL) {
   dump <- model$dump_model(num_iteration)
   feature_names <- unlist(dump$feature_names)
 
+  # accumulate one row (as a plain list) per node into `rows`, then build
+  # the frame ONCE — per-node data.frame rbind is quadratic and makes a
+  # 500-tree table take minutes
+  rows <- vector("list", 0L)
+
   flatten_node <- function(node, tree_index, parent) {
     if (is.null(node$split_index)) {
       # leaf; a 1-leaf tree's root carries only leaf_value
-      return(data.frame(
+      rows[[length(rows) + 1L]] <<- list(
         tree_index = tree_index,
         split_index = NA_integer_,
         split_feature = NA_character_,
@@ -30,11 +35,11 @@ lgb.model.dt.tree <- function(model, num_iteration = NULL) {
         internal_count = NA_integer_,
         leaf_value = as.numeric(node$leaf_value),
         leaf_count = if (is.null(node$leaf_count)) NA_integer_
-                     else as.integer(node$leaf_count),
-        stringsAsFactors = FALSE))
+                     else as.integer(node$leaf_count))
+      return(invisible(NULL))
     }
     idx <- as.integer(node$split_index)
-    row <- data.frame(
+    rows[[length(rows) + 1L]] <<- list(
       tree_index = tree_index,
       split_index = idx,
       split_feature = feature_names[as.integer(node$split_feature) + 1L],
@@ -47,19 +52,23 @@ lgb.model.dt.tree <- function(model, num_iteration = NULL) {
       internal_value = as.numeric(node$internal_value),
       internal_count = as.integer(node$internal_count),
       leaf_value = NA_real_,
-      leaf_count = NA_integer_,
-      stringsAsFactors = FALSE)
-    rbind(row,
-          flatten_node(node$left_child, tree_index, idx),
-          flatten_node(node$right_child, tree_index, idx))
+      leaf_count = NA_integer_)
+    flatten_node(node$left_child, tree_index, idx)
+    flatten_node(node$right_child, tree_index, idx)
+    invisible(NULL)
   }
 
-  pieces <- lapply(seq_along(dump$tree_info), function(i) {
-    tree <- dump$tree_info[[i]]
-    flatten_node(tree$tree_structure, i - 1L, NA_integer_)
-  })
-  out <- do.call(rbind, pieces)
-  rownames(out) <- NULL
+  for (i in seq_along(dump$tree_info)) {
+    flatten_node(dump$tree_info[[i]]$tree_structure, i - 1L, NA_integer_)
+  }
+  if (!length(rows)) {
+    return(data.frame(tree_index = integer(0)))
+  }
+  cols <- names(rows[[1L]])
+  out <- as.data.frame(
+    stats::setNames(lapply(cols, function(cn)
+      unlist(lapply(rows, `[[`, cn), use.names = FALSE)), cols),
+    stringsAsFactors = FALSE)
   if (requireNamespace("data.table", quietly = TRUE)) {
     out <- data.table::as.data.table(out)
   }

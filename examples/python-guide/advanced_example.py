@@ -1,0 +1,65 @@
+"""Continued training, learning-rate decay, custom fobj/feval, and model
+introspection (reference examples/python-guide/advanced_example.py flow)."""
+
+import json
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def load(path):
+    data = np.loadtxt(path, delimiter="\t")
+    return data[:, 1:], data[:, 0]
+
+
+X_train, y_train = load("../binary_classification/binary.train")
+X_test, y_test = load("../binary_classification/binary.test")
+
+lgb_train = lgb.Dataset(X_train, y_train, free_raw_data=False)
+lgb_eval = lgb.Dataset(X_test, y_test, reference=lgb_train,
+                       free_raw_data=False)
+
+params = {"boosting_type": "gbdt", "objective": "binary",
+          "metric": "binary_logloss", "num_leaves": 31, "verbose": 0}
+
+# train 10 rounds, persist, continue 10 more from the saved model
+gbm = lgb.train(params, lgb_train, num_boost_round=10,
+                valid_sets=[lgb_eval])
+gbm.save_model("model.txt")
+print("Dump model to JSON...")
+model_json = gbm.dump_model()
+with open("model.json", "w") as fh:
+    json.dump(model_json, fh, indent=2)
+
+print("Feature importances:", list(gbm.feature_importance()))
+
+gbm = lgb.train(params, lgb_train, num_boost_round=10,
+                init_model="model.txt", valid_sets=[lgb_eval])
+print("Finish 10 - 20 rounds with model file...")
+
+# learning-rate decay via reset_parameter callback
+gbm = lgb.train(params, lgb_train, num_boost_round=10,
+                init_model=gbm, valid_sets=[lgb_eval],
+                callbacks=[lgb.reset_parameter(
+                    learning_rate=lambda it: 0.05 * (0.99 ** it))])
+print("Finish 20 - 30 rounds with decay learning rates...")
+
+
+# custom objective (log-likelihood) + custom eval metric
+def loglikelood(preds, train_data):
+    labels = train_data.get_label()
+    preds = 1.0 / (1.0 + np.exp(-preds))
+    return preds - labels, preds * (1.0 - preds)
+
+
+def binary_error(preds, train_data):
+    labels = train_data.get_label()
+    return "error", float(np.mean(labels != (preds > 0.5))), False
+
+
+gbm = lgb.train({**params, "objective": "none", "metric": "None"},
+                lgb_train, num_boost_round=10, init_model=gbm,
+                fobj=loglikelood, feval=binary_error,
+                valid_sets=[lgb_eval])
+print("Finish 30 - 40 rounds with self-defined objective and eval...")

@@ -1,0 +1,42 @@
+"""Plotting helpers (reference examples/python-guide/plot_example.py):
+metric curves, importances, and a tree, written to PNG files when
+matplotlib is available."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+try:
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+except ImportError:
+    raise SystemExit("matplotlib is not installed; nothing to plot")
+
+
+def load(path):
+    data = np.loadtxt(path, delimiter="\t")
+    return data[:, 1:], data[:, 0]
+
+
+X_train, y_train = load("../regression/regression.train")
+X_test, y_test = load("../regression/regression.test")
+
+lgb_train = lgb.Dataset(X_train, y_train)
+lgb_eval = lgb.Dataset(X_test, y_test, reference=lgb_train)
+
+evals_result = {}
+gbm = lgb.train({"num_leaves": 5, "metric": ("l1", "l2"), "verbose": 0,
+                 "objective": "regression"},
+                lgb_train, num_boost_round=30,
+                valid_sets=[lgb_train, lgb_eval],
+                valid_names=["train", "eval"],
+                callbacks=[lgb.record_evaluation(evals_result)])
+
+ax = lgb.plot_metric(evals_result, metric="l1")
+plt.savefig("metric.png")
+ax = lgb.plot_importance(gbm, max_num_features=10)
+plt.savefig("importance.png")
+ax = lgb.plot_tree(gbm, tree_index=0)
+plt.savefig("tree.png")
+print("wrote metric.png importance.png tree.png")

@@ -1,0 +1,42 @@
+"""Train / early-stop / predict with the core train() API
+(reference examples/python-guide/simple_example.py flow)."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def load(path):
+    data = np.loadtxt(path, delimiter="\t")
+    return data[:, 1:], data[:, 0]
+
+
+X_train, y_train = load("../regression/regression.train")
+X_test, y_test = load("../regression/regression.test")
+
+lgb_train = lgb.Dataset(X_train, y_train)
+lgb_eval = lgb.Dataset(X_test, y_test, reference=lgb_train)
+
+params = {
+    "boosting_type": "gbdt",
+    "objective": "regression",
+    "metric": "l2",
+    "num_leaves": 31,
+    "learning_rate": 0.05,
+    "feature_fraction": 0.9,
+    "bagging_fraction": 0.8,
+    "bagging_freq": 5,
+    "verbose": 0,
+}
+
+print("Start training...")
+gbm = lgb.train(params, lgb_train, num_boost_round=20,
+                valid_sets=[lgb_eval], early_stopping_rounds=5)
+
+print("Save model...")
+gbm.save_model("model.txt")
+
+print("Start predicting...")
+y_pred = gbm.predict(X_test, num_iteration=gbm.best_iteration)
+rmse = float(np.sqrt(np.mean((y_pred - y_test) ** 2)))
+print(f"The rmse of prediction is: {rmse}")

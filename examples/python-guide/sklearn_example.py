@@ -1,0 +1,35 @@
+"""sklearn-style estimator + GridSearchCV
+(reference examples/python-guide/sklearn_example.py flow)."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def load(path):
+    data = np.loadtxt(path, delimiter="\t")
+    return data[:, 1:], data[:, 0]
+
+
+X_train, y_train = load("../regression/regression.train")
+X_test, y_test = load("../regression/regression.test")
+
+gbm = lgb.LGBMRegressor(objective="regression", num_leaves=31,
+                        learning_rate=0.05, n_estimators=20)
+gbm.fit(X_train, y_train, eval_set=[(X_test, y_test)], eval_metric="l1",
+        early_stopping_rounds=5)
+
+y_pred = gbm.predict(X_test, num_iteration=gbm.best_iteration_)
+print("The rmse of prediction is:",
+      float(np.sqrt(np.mean((y_pred - y_test) ** 2))))
+print("Feature importances:", list(gbm.feature_importances_))
+
+try:
+    from sklearn.model_selection import GridSearchCV
+    estimator = lgb.LGBMRegressor()
+    param_grid = {"learning_rate": [0.01, 0.1], "n_estimators": [10, 20]}
+    gbm = GridSearchCV(estimator, param_grid, cv=3)
+    gbm.fit(X_train, y_train)
+    print("Best parameters found by grid search are:", gbm.best_params_)
+except ImportError:
+    print("scikit-learn not installed; skipping the grid-search half")

@@ -339,8 +339,18 @@ class Dataset:
         return self
 
     def _set_predictor(self, predictor):
-        if self._binned is not None and predictor is not None:
-            raise LightGBMError("Cannot set predictor after construction")
+        if self._binned is not None and predictor is not None \
+                and predictor is not self._predictor:
+            # continued training on an already-constructed Dataset: the
+            # reference re-constructs from raw data to bake the new init
+            # scores in (basic.py _set_predictor + free_raw_data
+            # semantics); without raw data it must refuse
+            if self.data is None or self.free_raw_data:
+                raise LightGBMError(
+                    "Cannot set predictor after construction (set "
+                    "free_raw_data=False to allow continued training on "
+                    "a constructed Dataset)")
+            self._binned = None
         self._predictor = predictor
         return self
 

@@ -362,6 +362,11 @@ class GBDT:
     def _gradients(self) -> Tuple[jax.Array, jax.Array]:
         return self._grad_fn(self.train_data.score)
 
+    def _transform_host_gradients(self, grad, hess):
+        """Hook for subclasses that post-process gradients regardless of
+        their source (GOSS sampling/amplification); identity here."""
+        return grad, hess
+
     def _make_train_step(self):
         """One fused jit for a full boosting iteration on the standard
         (non-fobj) path: gradients -> per-class grow -> score update ->
@@ -500,6 +505,10 @@ class GBDT:
                         self.num_class, -1)
                     hess = jnp.asarray(hess, jnp.float32).reshape(
                         self.num_class, -1)
+                    # GOSS-style subclasses sample/amplify host-provided
+                    # gradients too (the reference Bagging step is
+                    # objective-agnostic)
+                    grad, hess = self._transform_host_gradients(grad, hess)
                 tt.sync((grad, hess))
             with timetag.scope("GBDT::bagging"):
                 row_weight = self._bagging_mask(self.iter_)

@@ -64,10 +64,14 @@ def find_process_id(machines: List[Tuple[str, int]]) -> Optional[int]:
     if override is not None:
         return int(override)
     local = _local_addresses()
-    for i, (host, _) in enumerate(machines):
-        if host in local:
-            return i
-    return None
+    matches = [i for i, (host, _) in enumerate(machines) if host in local]
+    if len(matches) > 1:
+        # several processes per machine (same IP, different ports): the
+        # reference disambiguates by binding the listed port, which the
+        # jax runtime owns here — the launcher must number the processes
+        log.fatal("machine_list_file matches this host %d times; set "
+                  "LIGHTGBM_TPU_PROCESS_ID per process", len(matches))
+    return matches[0] if matches else None
 
 
 def maybe_initialize_distributed(config) -> bool:

@@ -276,6 +276,12 @@ class LGBMModel(_SKBase):
         return self._best_iteration
 
     @property
+    def best_iteration_(self) -> int:
+        """sklearn-convention alias (reference sklearn.py exposes the
+        trailing-underscore spelling)."""
+        return self._best_iteration
+
+    @property
     def evals_result_(self):
         return self._evals_result
 
