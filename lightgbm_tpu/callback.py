@@ -72,30 +72,45 @@ def record_evaluation(eval_result):
     return callback
 
 
+_UNRESETTABLE = frozenset({"num_class", "boosting_type", "metric"})
+
+
+def _schedule_value(key, schedule, step, total):
+    """Evaluate one reset_parameter schedule at iteration offset `step`.
+
+    A list schedule is indexed (and must cover every round); anything else
+    is treated as a callable of the offset."""
+    if isinstance(schedule, list):
+        if len(schedule) != total:
+            raise ValueError(
+                f"reset_parameter: list for {key!r} has {len(schedule)} "
+                f"entries but training runs {total} rounds")
+        return schedule[step]
+    return schedule(step)
+
+
 def reset_parameter(**kwargs):
     """Reset parameters after the first iteration: value may be a list
     (per-iteration) or a function of the iteration (callback.py:100-141).
 
     Example: reset_parameter(learning_rate=lambda i: 0.1 * 0.99 ** i)
     """
+    bad = _UNRESETTABLE.intersection(kwargs)
+    if bad:
+        raise RuntimeError(
+            f"cannot reset {sorted(bad)[0]} during training")
+
     def callback(env: CallbackEnv):
-        new_parameters = {}
-        for key, value in kwargs.items():
-            if key in ("num_class", "boosting_type", "metric"):
-                raise RuntimeError(f"cannot reset {key} during training")
-            if isinstance(value, list):
-                if len(value) != env.end_iteration - env.begin_iteration:
-                    raise ValueError(
-                        f"Length of list {key!r} has to equal to "
-                        "'num_boost_round'.")
-                new_param = value[env.iteration - env.begin_iteration]
-            else:
-                new_param = value(env.iteration - env.begin_iteration)
-            if new_param != env.params.get(key, None):
-                new_parameters[key] = new_param
-        if new_parameters:
-            env.model.reset_parameter(new_parameters)
-            env.params.update(new_parameters)
+        step = env.iteration - env.begin_iteration
+        total = env.end_iteration - env.begin_iteration
+        changed = {}
+        for key, schedule in kwargs.items():
+            value = _schedule_value(key, schedule, step, total)
+            if env.params.get(key) != value:
+                changed[key] = value
+        if changed:
+            env.model.reset_parameter(changed)
+            env.params.update(changed)
     callback.before_iteration = True
     callback.order = 10
     return callback

@@ -30,6 +30,69 @@ from ..utils import log, timetag
 from .tree import Tree
 
 
+def estimate_train_memory(num_data: int, num_features: int, num_leaves: int,
+                          max_bin: int, num_models: int,
+                          bin_itemsize: int = 1) -> Dict[str, int]:
+    """Rough per-device HBM footprint (bytes) of training, by component.
+
+    The dense-on-device design (SURVEY §7.2) has no sparse-bin fallback
+    (reference sparse_bin.hpp stores sparse data ~20x smaller) and keeps
+    the per-leaf histogram cache fully resident instead of LRU-bounding it
+    (reference HistogramPool, feature_histogram.hpp:299-455) — so unlike
+    the reference, an oversize problem cannot spill; it must fail fast at
+    construction with this estimate instead of dying in XLA allocation.
+
+    Components mirror what training actually allocates: column- and
+    row-major bin copies (+ word-packed lanes for the ordered grower,
+    padded to the largest window class), the 9-stream int8 digit payload,
+    per-class score buffers, and the [L, F, 9, B] int32 histogram cache.
+    ``working`` doubles the sort payload: lax.sort and the window
+    update-slices hold one extra copy of their operands live."""
+    from ..ops.ordered_grow import _size_classes
+
+    n, f = num_data, num_features
+    pad = _size_classes(max(n, 1))[-1]
+    words = -(-f // 4) if bin_itemsize == 1 else 0
+    bins_cm = n * f * bin_itemsize
+    bins_rm = n * f * bin_itemsize
+    bins_words = (n + pad) * words * 4
+    digits = (n + pad) * 16 + n * 9          # dig_w (3 words) + row_ord + [N,9]
+    # score, grad, hess, and the per-class prediction delta are all live
+    # at once at the peak of a boosting step
+    scores = num_models * n * 4 * 4
+    cache = num_leaves * f * 9 * max_bin * 4
+    payload = bins_words + digits
+    return {
+        "bins_device": bins_cm + bins_rm,
+        "packed_payload": payload,
+        "scores_and_gradients": scores,
+        "histogram_cache": cache,
+        "working": payload,
+        "total": bins_cm + bins_rm + 2 * payload + scores + cache,
+    }
+
+
+def _device_memory_limit() -> Optional[int]:
+    """Per-device memory budget in bytes, or None when unknown.
+
+    LGBT_DEVICE_MEMORY_BYTES overrides (test rigs, CPU backends whose
+    memory_stats report nothing useful)."""
+    env = os.environ.get("LGBT_DEVICE_MEMORY_BYTES")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            log.warning("LGBT_DEVICE_MEMORY_BYTES=%r is not an integer; "
+                        "ignoring", env)
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats:
+            return stats.get("bytes_limit")
+    except Exception:  # pragma: no cover - backend without memory_stats
+        pass
+    return None
+
+
 class _DeviceData:
     """Device-resident binned dataset + per-dataset score buffer
     (ScoreUpdater, score_updater.hpp:23-99)."""
@@ -78,17 +141,26 @@ class _DeviceData:
 
 @functools.partial(jax.jit, static_argnames=("n", "bag_cnt"))
 def _device_bag_mask(key, n: int, bag_cnt: int):
-    """Exact-count sample without replacement: kth order statistic of
-    per-row uniforms as the keep threshold (count can differ from
-    bag_cnt only on float ties, which jax.random.uniform makes
-    vanishingly rare)."""
+    """EXACT-count sample without replacement (reference bag_data_cnt_).
+
+    Ranks rows by raw 32-bit random words with the row index as a total-
+    order tie-break: f32 uniforms sit on a ~2^-23 grid, so at N=1M the
+    kth order statistic collides with another row in roughly 1 of 8
+    draws and a value-only threshold would keep bag_cnt+1 rows.  The
+    (word, index) pair is unique, so exactly bag_cnt rows satisfy
+    pair <= pair_sorted[bag_cnt - 1]."""
     if bag_cnt <= 0:
         # matches the host-draw degenerate case (reference bag_data_cnt=0
         # keeps nothing); the wrapped [-1] index would keep EVERYTHING
         return jnp.zeros((n,), jnp.float32)
-    r = jax.random.uniform(key, (n,))
-    thr = jnp.sort(r)[bag_cnt - 1]
-    return (r <= thr).astype(jnp.float32)
+    r = jax.random.bits(key, (n,), jnp.uint32)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    r_sorted, i_sorted = jax.lax.sort((r, iota), num_keys=1,
+                                      is_stable=True)
+    thr_r = r_sorted[bag_cnt - 1]
+    thr_i = i_sorted[bag_cnt - 1]
+    keep = (r < thr_r) | ((r == thr_r) & (iota <= thr_i))
+    return keep.astype(jnp.float32)
 
 
 class GBDT:
@@ -141,6 +213,7 @@ class GBDT:
         self.grow_params = self._make_grow_params(cfg)
         self.shrinkage_rate = cfg.learning_rate
 
+        self._check_memory_budget(cfg, train_set)
         self.train_data = _DeviceData(train_set, self.num_class,
                                       with_row_major=True)
         self.valid_data: List[_DeviceData] = []
@@ -159,6 +232,39 @@ class GBDT:
                                          bool)
         self._lr_cache: Tuple[float, jax.Array] = (-1.0, jnp.float32(0))
         self._train_step = None
+
+    def _check_memory_budget(self, cfg: Config,
+                             train_set: BinnedDataset) -> None:
+        """Fail fast (with a breakdown) when the dense-on-device training
+        state cannot fit the device, instead of dying later in an XLA
+        allocation error; warn loudly when ``histogram_pool_size`` asks
+        for an LRU bound the resident-cache design does not provide
+        (reference feature_histogram.hpp:299-455)."""
+        est = estimate_train_memory(
+            train_set.num_data, train_set.num_features, cfg.num_leaves,
+            cfg.max_bin, self.num_class,
+            bin_itemsize=train_set.bins.dtype.itemsize)
+        pool_mb = float(getattr(cfg, "histogram_pool_size", -1.0) or -1.0)
+        if pool_mb > 0 and est["histogram_cache"] > pool_mb * (1 << 20):
+            log.warning(
+                "histogram_pool_size=%.0fMB requested but the TPU design "
+                "keeps the whole per-leaf histogram cache resident "
+                "(%.0fMB for num_leaves=%d x %d features x 9 x %d bins); "
+                "the parameter is accepted for config compatibility and "
+                "does NOT bound memory — lower num_leaves/max_bin to "
+                "shrink the cache", pool_mb,
+                est["histogram_cache"] / (1 << 20), cfg.num_leaves,
+                train_set.num_features, cfg.max_bin)
+        limit = _device_memory_limit()
+        if limit and est["total"] > limit:
+            parts = ", ".join(f"{k}={v / (1 << 20):.0f}MB"
+                              for k, v in est.items() if k != "total")
+            log.fatal(
+                "estimated training memory %.0fMB exceeds the device "
+                "budget %.0fMB (%s).  The dense-only design has no sparse "
+                "spill (SURVEY §7.2): shrink num_leaves/max_bin or train "
+                "on fewer rows.", est["total"] / (1 << 20),
+                limit / (1 << 20), parts)
 
     @staticmethod
     def _make_grow_params(cfg: Config) -> GrowParams:
@@ -213,8 +319,14 @@ class GBDT:
                 mesh = Mesh(np.array(jax.devices()[:k]), ("data",))
                 log.info("Using %s-parallel tree learner over %d devices",
                          cfg.tree_learner, k)
-                return make_parallel_grow(mesh, cfg.tree_learner,
-                                          self.grow_params, top_k=cfg.top_k)
+                fn = make_parallel_grow(mesh, cfg.tree_learner,
+                                        self.grow_params, top_k=cfg.top_k)
+                if jax.process_count() > 1:
+                    # multi-controller runtime: promote per-process inputs
+                    # to global arrays / gather sharded outputs back
+                    from ..parallel.multihost import globalize_grow_fn
+                    fn = globalize_grow_fn(fn, mesh)
+                return fn
             log.warning("tree_learner=%s requested but only %d device(s) "
                         "available; falling back to serial",
                         cfg.tree_learner, ndev)
@@ -457,14 +569,22 @@ class GBDT:
             # dispatching — and clear it so a later retry trains afresh
             self._no_more_splits = False
             return True
-        # The fused step computes gradients INSIDE the jit, so it only
-        # applies when this instance uses the plain objective pass —
-        # subclasses overriding _gradients with host-side work per round
-        # (GOSS sampling/amplification, custom boosters) must take the
-        # per-stage path.  LGBT_NO_FUSED_STEP=1/true also forces it (same
-        # results; smaller XLA programs for compile-constrained setups).
+        # The fused step computes gradients INSIDE the jit and never calls
+        # the _gradients / _transform_host_gradients hooks, so it only
+        # applies when this instance uses the base implementations of ALL
+        # per-round hooks (GOSS sampling/amplification and custom boosters
+        # override them and need the per-stage path; _bagging_mask is
+        # checked too, conservatively, so any hook override routes through
+        # the path that visibly runs every hook).  LGBT_NO_FUSED_STEP=1/
+        # true also forces per-stage (same results; smaller XLA programs
+        # for compile-constrained setups).
         fused = (grad is None and hess is None
                  and type(self)._gradients is GBDT._gradients
+                 and type(self)._transform_host_gradients
+                 is GBDT._transform_host_gradients
+                 and type(self)._bagging_mask is GBDT._bagging_mask
+                 and jax.process_count() == 1  # multihost grow fn is a
+                 # host-side bridge (globalize_grow_fn), not jit-traceable
                  and os.environ.get("LGBT_NO_FUSED_STEP", "").lower()
                  not in ("1", "true", "yes"))
         if self._lr_cache[0] != self.shrinkage_rate:

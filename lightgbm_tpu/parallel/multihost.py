@@ -42,7 +42,12 @@ def parse_machine_list(path: str) -> List[Tuple[str, int]]:
             parts = line.replace(",", " ").split()
             if len(parts) < 2:
                 log.fatal("machine_list_file: malformed line %r", line)
-            out.append((parts[0], int(parts[1])))
+            try:
+                port = int(parts[1])
+            except ValueError:
+                log.fatal("machine_list_file: port %r on line %r is not an "
+                          "integer", parts[1], line)
+            out.append((parts[0], port))
     return out
 
 
@@ -62,7 +67,11 @@ def find_process_id(machines: List[Tuple[str, int]]) -> Optional[int]:
     own-entry search), or None when no entry matches."""
     override = os.environ.get("LIGHTGBM_TPU_PROCESS_ID")
     if override is not None:
-        return int(override)
+        try:
+            return int(override)
+        except ValueError:
+            log.fatal("LIGHTGBM_TPU_PROCESS_ID=%r is not an integer",
+                      override)
     local = _local_addresses()
     matches = [i for i, (host, _) in enumerate(machines) if host in local]
     if len(matches) > 1:
@@ -72,6 +81,59 @@ def find_process_id(machines: List[Tuple[str, int]]) -> Optional[int]:
         log.fatal("machine_list_file matches this host %d times; set "
                   "LIGHTGBM_TPU_PROCESS_ID per process", len(matches))
     return matches[0] if matches else None
+
+
+def globalize_grow_fn(grow_fn, mesh):
+    """Bridge a mesh-jitted grow fn into a per-process training loop.
+
+    Under a multi-controller runtime (jax.distributed) the GBDT iteration
+    state (scores, gradients, bags) is PROCESS-LOCAL and replicated — every
+    process computes identical values from identical seeds, exactly like
+    the reference's per-machine GBDT state around its parallel tree
+    learners (SURVEY §2.8).  Only tree growth spans processes.  This
+    wrapper promotes the (replicated) host values to global arrays on the
+    mesh, runs the distributed grow, and gathers the row-sharded outputs
+    (leaf_id, score delta) back to every process so the local score update
+    can proceed."""
+    import numpy as np
+    import jax
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    replicated = NamedSharding(mesh, PartitionSpec())
+    # The leading args (bins, num_bin, is_cat) are per-dataset constants:
+    # promote them ONCE instead of pulling the full bin matrix through the
+    # host every iteration (x num_class).  Keyed by identity — the caller
+    # passes the same resident arrays each round.
+    static_cache = {}
+
+    def _promote(a):
+        return jax.make_array_from_callback(
+            np.shape(a), replicated, lambda idx, a=a: np.asarray(a)[idx])
+
+    def wrapped(*args):
+        glob = []
+        for i, a in enumerate(args):
+            if i < 3:
+                hit = static_cache.get(i)
+                if hit is None or hit[0] is not a:
+                    static_cache[i] = (a, _promote(a))
+                glob.append(static_cache[i][1])
+            else:
+                glob.append(_promote(a))
+        tree, leaf_id, delta = grow_fn(*glob)
+        # tree is replicated: every process holds the full value as its
+        # one addressable shard.  leaf_id and delta are row-sharded over
+        # processes -> all-gather them back to every process.
+        tree = jax.tree.map(
+            lambda x: jax.numpy.asarray(x.addressable_data(0)), tree)
+        leaf_id = jax.numpy.asarray(
+            multihost_utils.process_allgather(leaf_id, tiled=True))
+        delta = jax.numpy.asarray(
+            multihost_utils.process_allgather(delta, tiled=True))
+        return tree, leaf_id, delta
+
+    return wrapped
 
 
 def maybe_initialize_distributed(config) -> bool:
