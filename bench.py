@@ -109,11 +109,31 @@ def main() -> None:
         "unit": "iters/sec",
         "vs_baseline": round(vs, 4) if vs is not None else None,
     }))
+    # trailing comment line only — the JSON line above is the contract.
+    # LIGHTGBM_TPU_TIMETAG=1 folds the serializing per-phase breakdown in
+    # so BENCH_*.json tails carry phase data; the obs counters are always
+    # on (and must stay free: the acceptance gate for the telemetry layer
+    # is that a disabled-telemetry run sits inside the window spread).
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.utils import timetag
+    tail = ""
+    if timetag.ENABLED:
+        t = timetag.get_timings()
+        if t:
+            tail += " phases=" + json.dumps(
+                {k: round(v, 3) for k, v in sorted(t.items())},
+                separators=(",", ":"))
+    c = obs.snapshot()["counters"]
+    tail += (f" obs_iters={c.get('iterations', 0)}"
+             f" obs_trees={c.get('trees_grown', 0)}"
+             f" obs_d2h={c.get('device_to_host_transfers', 0)}"
+             f" obs_comm_bytes={c.get('comm_collective_bytes', 0)}")
     print(f"# device={jax.devices()[0].platform} bin_s={t_bin:.1f} "
           f"warmup_s={t_warm:.1f} timed_iters={num_timed} "
           f"windows={[round(r, 3) for r in rates]} "
           f"spread={min(rates):.3f}-{max(rates):.3f} "
-          f"auc={booster.eval_metrics().get('training', {}).get('auc')}",
+          f"auc={booster.eval_metrics().get('training', {}).get('auc')}"
+          f"{tail}",
           file=sys.stderr)
 
 

@@ -12,8 +12,9 @@ __version__ = "0.1.0"
 from .config import Config  # noqa: F401
 from .io import BinnedDataset, BinMapper, Metadata  # noqa: F401
 from .basic import Booster, Dataset  # noqa: F401
-from .callback import (early_stopping, print_evaluation,  # noqa: F401
-                       record_evaluation, reset_parameter)
+from .callback import (early_stopping, log_telemetry,  # noqa: F401
+                       print_evaluation, record_evaluation, reset_parameter)
+from . import obs  # noqa: F401
 from .engine import CVBooster, cv, train  # noqa: F401
 from .sklearn import (LGBMClassifier, LGBMModel,  # noqa: F401
                       LGBMRanker, LGBMRegressor)
@@ -29,4 +30,5 @@ __all__ = ["Dataset", "Booster", "Config",
            "train", "cv", "CVBooster",
            "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
            "print_evaluation", "record_evaluation", "reset_parameter",
-           "early_stopping", "LightGBMError"] + _PLOTTING
+           "early_stopping", "log_telemetry", "obs",
+           "LightGBMError"] + _PLOTTING

@@ -610,6 +610,31 @@ class Booster:
                else b.predict(X, num_iteration))
         return np.asarray(out)
 
+    # -- telemetry (lightgbm_tpu/obs/) -----------------------------------
+    def set_event_recorder(self, recorder) -> "Booster":
+        """Attach an ``obs.EventRecorder`` for the per-iteration JSONL
+        event stream (engine.train's ``events_file`` does this for you).
+        The caller owns the recorder: flush the pipeline (e.g. read
+        ``num_trees()``) before ``recorder.close()`` so the final
+        iteration's tree shape is captured."""
+        self._booster.set_event_recorder(recorder)
+        return self
+
+    def telemetry(self) -> Dict[str, Any]:
+        """Snapshot of the process-wide counters/gauges (obs registry,
+        plus timetag phase totals when enabled) and this booster's
+        cumulative collective-traffic account — the static per-tree
+        byte/call math from parallel/comm.py accumulated over training."""
+        from . import obs
+        snap = obs.snapshot()
+        b = self._booster
+        snap["comm"] = {
+            "bytes_cum": int(getattr(b, "_cum_comm_bytes", 0)),
+            "calls_cum": int(getattr(b, "_cum_comm_calls", 0)),
+            "per_tree": getattr(b, "_comm_traffic", None),
+        }
+        return snap
+
     # -- introspection ---------------------------------------------------
     def feature_name(self) -> List[str]:
         return list(self._booster.feature_names)

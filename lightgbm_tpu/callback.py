@@ -72,6 +72,29 @@ def record_evaluation(eval_result):
     return callback
 
 
+def log_telemetry(recorder=None):
+    """Feed per-iteration eval metric values into the telemetry event
+    stream (lightgbm_tpu/obs/).  With ``recorder=None`` the callback
+    resolves the recorder attached to the booster (engine.train's
+    ``events_file`` path); passing an ``obs.EventRecorder`` pins one
+    explicitly.  Runs after record_evaluation, before early_stopping, so
+    the stopped iteration's values are still captured."""
+    def callback(env: CallbackEnv):
+        rec = recorder
+        if rec is None:
+            inner = getattr(env.model, "_booster", None)
+            rec = getattr(inner, "_telemetry", None)
+        if rec is None or not env.evaluation_result_list:
+            return
+        ev = {}
+        for item in env.evaluation_result_list:
+            data_name, eval_name, value = item[0], item[1], item[2]
+            ev.setdefault(data_name, {})[eval_name] = float(value)
+        rec.note(env.iteration, eval=ev)
+    callback.order = 25
+    return callback
+
+
 _UNRESETTABLE = frozenset({"num_class", "boosting_type", "metric"})
 
 

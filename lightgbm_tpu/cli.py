@@ -9,6 +9,13 @@ Parameter precedence and parsing mirror Application::LoadParameters
 '#' starts a comment; keys run through the alias table.  task=train loads
 data (+optional valid sets + side files), trains, and saves the model;
 task=predict loads input_model and writes predictions to output_result.
+
+GNU-style flags normalize onto the same namespace (``--events-file=x``
+== ``events_file=x``): ``--events-file`` streams one JSONL telemetry
+record per boosting iteration (phase timings, eval values, tree shape,
+cumulative collective bytes — lightgbm_tpu/obs/, docs/OBSERVABILITY.md);
+``--trace-dir`` (or LIGHTGBM_TPU_TRACE_DIR) captures a device trace over
+a window of iterations.
 """
 
 from __future__ import annotations
@@ -93,7 +100,8 @@ def run_predict(config: Config, params: Dict[str, str]) -> None:
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv:
-        print("usage: python -m lightgbm_tpu config=<conf> [key=value ...]")
+        print("usage: python -m lightgbm_tpu config=<conf> [key=value ...] "
+              "[--events-file=<jsonl>] [--trace-dir=<dir>]")
         return 1
     params = parse_cli_args(argv)
     config = Config(params)

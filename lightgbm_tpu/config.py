@@ -190,6 +190,11 @@ _DEFAULTS: Dict[str, Any] = {
     # TPU-specific extensions (no reference equivalent)
     "tpu_histogram_impl": "auto",  # auto | scatter | onehot | pallas
     "tpu_double_hist": False,      # float64 histogram accumulation (CPU tests)
+    # observability (lightgbm_tpu/obs/; docs/OBSERVABILITY.md)
+    "events_file": "",         # per-iteration JSONL event stream path
+    "trace_dir": "",           # device trace dir (LIGHTGBM_TPU_TRACE_DIR wins)
+    "trace_start_iter": 5,     # first traced iteration (skip compile/warmup)
+    "trace_num_iters": 2,      # trace window length in iterations
 }
 
 _BOOL_KEYS = {k for k, v in _DEFAULTS.items() if isinstance(v, bool)}
@@ -419,9 +424,21 @@ def parse_cli_args(argv: List[str]) -> Dict[str, str]:
     params: Dict[str, str] = {}
     for token in argv:
         if "=" not in token:
+            if token.startswith("--"):
+                # the two-token GNU form (--events-file out.jsonl) is NOT
+                # supported — only --key=value; dropping it silently would
+                # disable the feature with no diagnostic
+                from .utils import log
+                log.warning("ignoring CLI flag %r: flags must use the "
+                            "--key=value form", token)
             continue
         key, value = token.split("=", 1)
-        params[key.strip()] = value.strip()
+        key = key.strip()
+        if key.startswith("--"):
+            # GNU-style flags (--events-file=out.jsonl) normalize onto the
+            # reference key=value namespace (events_file=out.jsonl)
+            key = key[2:].replace("-", "_")
+        params[key] = value.strip()
     params = apply_aliases(params)
     config_path = params.pop("config_file", None)
     if config_path:

@@ -27,9 +27,16 @@ def train(params, train_set, num_boost_round=100,
           fobj=None, feval=None, init_model=None,
           feature_name="auto", categorical_feature="auto",
           early_stopping_rounds=None, evals_result=None,
-          verbose_eval=True, learning_rates=None, callbacks=None):
-    """Train with given parameters; returns a Booster."""
+          verbose_eval=True, learning_rates=None, callbacks=None,
+          events_file=None):
+    """Train with given parameters; returns a Booster.
+
+    ``events_file`` (or the ``events_file`` params key / CLI
+    ``--events-file``) streams one JSONL telemetry record per boosting
+    iteration — phase timings, eval values, tree shape, cumulative
+    collective bytes (lightgbm_tpu/obs/, docs/OBSERVABILITY.md)."""
     params = dict(params or {})
+    events_file = events_file or params.get("events_file") or None
     if fobj is not None:
         params["objective"] = "none"
     for alias in ("num_boost_round", "num_iterations", "num_iteration",
@@ -91,8 +98,19 @@ def train(params, train_set, num_boost_round=100,
     for vs, name in zip(reduced_valid_sets, name_valid_sets):
         booster.add_valid(vs, name)
 
+    # telemetry event stream (lightgbm_tpu/obs/): the recorder is owned
+    # here — attached to the booster for per-iteration notes, fed eval
+    # values by log_telemetry, drained+closed after the loop.
+    recorder = None
+    if events_file:
+        from .obs import EventRecorder
+        recorder = EventRecorder(str(events_file))
+        booster._booster.set_event_recorder(recorder)
+
     # callbacks (engine.py:113-142)
     cbs = set(callbacks or [])
+    if recorder is not None:
+        cbs.add(callback.log_telemetry())
     if verbose_eval is True:
         cbs.add(callback.print_evaluation())
     elif isinstance(verbose_eval, int) and verbose_eval is not False:
@@ -113,36 +131,53 @@ def train(params, train_set, num_boost_round=100,
                              key=lambda cb: getattr(cb, "order", 0))
 
     # boosting loop (engine.py:143-203)
-    for i in range(init_iteration, init_iteration + num_boost_round):
-        for cb in callbacks_before:
-            cb(callback.CallbackEnv(model=booster, params=params,
-                                    iteration=i,
-                                    begin_iteration=init_iteration,
-                                    end_iteration=init_iteration
-                                    + num_boost_round,
-                                    evaluation_result_list=None))
-        finished = booster.update(fobj=fobj)
+    try:
+        for i in range(init_iteration, init_iteration + num_boost_round):
+            for cb in callbacks_before:
+                cb(callback.CallbackEnv(model=booster, params=params,
+                                        iteration=i,
+                                        begin_iteration=init_iteration,
+                                        end_iteration=init_iteration
+                                        + num_boost_round,
+                                        evaluation_result_list=None))
+            finished = booster.update(fobj=fobj)
 
-        evaluation_result_list = []
-        if is_valid_contain_train:
-            evaluation_result_list.extend(booster.eval_train(feval))
-        if reduced_valid_sets:
-            evaluation_result_list.extend(booster.eval_valid(feval))
-        try:
-            for cb in callbacks_after:
-                cb(callback.CallbackEnv(
-                    model=booster, params=params, iteration=i,
-                    begin_iteration=init_iteration,
-                    end_iteration=init_iteration + num_boost_round,
-                    evaluation_result_list=evaluation_result_list))
-        except callback.EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
-            break
-        if finished:
-            # No leaf met the split requirements: the model is saturated and
-            # further rounds would re-do full histogram work for nothing
-            # (the CLI loop breaks the same way, application.cpp:231).
-            break
+            evaluation_result_list = []
+            if is_valid_contain_train:
+                evaluation_result_list.extend(booster.eval_train(feval))
+            if reduced_valid_sets:
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in callbacks_after:
+                    cb(callback.CallbackEnv(
+                        model=booster, params=params, iteration=i,
+                        begin_iteration=init_iteration,
+                        end_iteration=init_iteration + num_boost_round,
+                        evaluation_result_list=evaluation_result_list))
+            except callback.EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                break
+            if finished:
+                # No leaf met the split requirements: the model is saturated
+                # and further rounds would re-do full histogram work for
+                # nothing (the CLI loop breaks the same way,
+                # application.cpp:231).
+                break
+    finally:
+        # a trace window the run ended inside must stop now, not at exit
+        booster._booster.close_trace()
+        if recorder is not None:
+            # drain the pipelined last iteration so its tree shape lands in
+            # the final record; best-effort, because if the loop is already
+            # unwinding an exception the pending device arrays may be
+            # poisoned and the flush must not mask the root cause (or skip
+            # the close that writes the drained records out)
+            try:
+                booster._booster._flush_pending()
+            except Exception:
+                pass
+            recorder.close()
+            booster._booster.set_event_recorder(None)
     return booster
 
 

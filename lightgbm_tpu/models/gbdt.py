@@ -12,12 +12,14 @@ from __future__ import annotations
 import functools
 import io
 import os
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..config import Config
 from ..io.binning import CATEGORICAL
 from ..io.dataset import BinnedDataset
@@ -100,6 +102,7 @@ class _DeviceData:
     def __init__(self, dataset: BinnedDataset, num_models: int,
                  with_row_major: bool = False):
         self.dataset = dataset
+        h2d_xfers, h2d_bytes = 1, int(dataset.bins.nbytes)
         # Native uint8/uint16 on device (int32 would 4x the HBM footprint
         # and the histogram kernel's read traffic).
         self.bins = jnp.asarray(dataset.bins)
@@ -107,6 +110,9 @@ class _DeviceData:
         # (ops/leafhist.py needs rows contiguous).
         self.bins_rm = (jnp.asarray(np.ascontiguousarray(dataset.bins.T))
                         if with_row_major else None)
+        if self.bins_rm is not None:
+            h2d_xfers += 1
+            h2d_bytes += int(dataset.bins.nbytes)
         # Word-packed payload lanes for the leaf-ordered grower, shared
         # across trees (uint8 bins only; uint16 routes to the cached
         # learner).
@@ -128,6 +134,8 @@ class _DeviceData:
             init += np.asarray(dataset.metadata.init_score,
                                np.float32).reshape(num_models, self.num_data)
         self.score = jnp.asarray(init)
+        obs.inc("host_to_device_transfers", h2d_xfers + 1)
+        obs.inc("host_to_device_bytes", h2d_bytes + int(init.nbytes))
 
     def add_tree(self, tree_arrays, is_cat, cls: int, max_steps: int):
         n = tree_arrays.split_feature.shape[0]
@@ -180,6 +188,15 @@ class GBDT:
     _pending_iter = None          # [tree_arrays] of the last iteration
     _pending_shrinkage = 1.0
     _no_more_splits = False
+    # -- telemetry (lightgbm_tpu/obs/; all optional, None/zero = off) ----
+    _telemetry = None             # obs.EventRecorder (set_event_recorder)
+    _trace = None                 # obs.TraceCapture window (env/config)
+    _comm_traffic = None          # static per-tree collective account
+    _comm_traffic_totals = (0, 0)  # (calls, bytes) per tree, precomputed
+    _cum_comm_bytes = 0
+    _cum_comm_calls = 0
+    _bag_cnt = 0                  # rows in the current bagging draw
+    _pending_iter_idx = -1        # iteration index of _pending_iter
 
     def __init__(self, config: Config, train_set: Optional[BinnedDataset],
                  objective: Optional[ObjectiveFunction] = None):
@@ -220,6 +237,8 @@ class GBDT:
         self.valid_metrics: List[List[Metric]] = []
         self.train_metrics = self._make_metrics(cfg, train_set)
 
+        self._trace = obs.TraceCapture.from_config(cfg)
+        self._bag_cnt = self.num_data
         self._bag_key = jax.random.PRNGKey(cfg.bagging_seed)
         self._feature_rng = np.random.RandomState(cfg.feature_fraction_seed)
         self._row_weight = jnp.ones(self.num_data, jnp.float32)
@@ -244,9 +263,13 @@ class GBDT:
             train_set.num_data, train_set.num_features, cfg.num_leaves,
             cfg.max_bin, self.num_class,
             bin_itemsize=train_set.bins.dtype.itemsize)
+        obs.set_gauge("hbm_train_estimate_bytes", int(est["total"]))
+        obs.set_gauge("hbm_histogram_cache_bytes",
+                      int(est["histogram_cache"]))
         pool_mb = float(getattr(cfg, "histogram_pool_size", -1.0) or -1.0)
         if pool_mb > 0 and est["histogram_cache"] > pool_mb * (1 << 20):
-            log.warning(
+            log.warn_once(
+                "histogram_pool_size",
                 "histogram_pool_size=%.0fMB requested but the TPU design "
                 "keeps the whole per-leaf histogram cache resident "
                 "(%.0fMB for num_leaves=%d x %d features x 9 x %d bins); "
@@ -256,6 +279,7 @@ class GBDT:
                 est["histogram_cache"] / (1 << 20), cfg.num_leaves,
                 train_set.num_features, cfg.max_bin)
         limit = _device_memory_limit()
+        obs.set_gauge("hbm_budget_bytes", int(limit) if limit else -1)
         if limit and est["total"] > limit:
             parts = ", ".join(f"{k}={v / (1 << 20):.0f}MB"
                               for k, v in est.items() if k != "total")
@@ -304,6 +328,8 @@ class GBDT:
         num_machines bounds the mesh size (it is the reference's machine
         count; here it is a device count)."""
         cfg = self.config
+        self._comm_traffic = None           # serial: no collectives
+        self._comm_traffic_totals = (0, 0)
         if getattr(cfg, "is_parallel", False):
             ndev = len(jax.devices())
             # single-controller-per-host: num_machines counts HOSTS (the
@@ -321,6 +347,11 @@ class GBDT:
                          cfg.tree_learner, k)
                 fn = make_parallel_grow(mesh, cfg.tree_learner,
                                         self.grow_params, top_k=cfg.top_k)
+                # static per-tree collective account (obs layer): computed
+                # once from shapes, accumulated per iteration
+                from ..parallel.comm import traffic_totals
+                self._comm_traffic = fn.traffic_per_tree(self.num_features)
+                self._comm_traffic_totals = traffic_totals(self._comm_traffic)
                 if jax.process_count() > 1:
                     # multi-controller runtime: promote per-process inputs
                     # to global arrays / gather sharded outputs back
@@ -443,11 +474,14 @@ class GBDT:
         more than the tree it was supposed to shrink."""
         cfg = self.config
         if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
+            self._bag_cnt = self.num_data
             return jnp.ones(self.num_data, jnp.float32)
         if iter_ % cfg.bagging_freq == 0:
             bag_cnt = int(cfg.bagging_fraction * self.num_data)
             self._bag_key, sub = jax.random.split(self._bag_key)
             self._row_weight = _device_bag_mask(sub, self.num_data, bag_cnt)
+            self._bag_cnt = bag_cnt
+            obs.inc("bagging_draws")
         return self._row_weight
 
     def _feature_mask(self) -> jax.Array:
@@ -529,21 +563,74 @@ class GBDT:
         if not pend:
             return
         self._pending_iter = None
+        pend_idx, self._pending_iter_idx = self._pending_iter_idx, -1
         with timetag.scope("GBDT::host_tree"):
             host = jax.device_get([packed for packed, _, _ in pend])
+        obs.inc("device_to_host_transfers")
+        obs.inc("device_to_host_bytes",
+                sum(int(iv.nbytes) + int(fv.nbytes) for iv, fv in host))
         L = self.grow_params.num_leaves
         trees = [Tree.from_arrays(unpack_tree_arrays(iv, fv, L),
                                   self.train_set.mappers,
                                   self.train_set.used_feature_map,
                                   self._pending_shrinkage)
                  for iv, fv in host]
+        rec = self._telemetry
+        shapes = ([{"num_leaves": int(t.num_leaves),
+                    "max_depth": int(t.max_depth())} for t in trees]
+                  if rec is not None and pend_idx >= 0 else None)
         if all(t.num_leaves <= 1 for t in trees):
             log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements.")
             self._no_more_splits = True
             self.iter_ -= 1
+            if shapes is not None:
+                rec.note(pend_idx, saturated=True, trees=shapes)
         else:
             self._models.extend(trees)
+            obs.inc("trees_grown", len(trees))
+            if shapes is not None:
+                rec.note(pend_idx, trees=shapes)
+
+    # -- telemetry (lightgbm_tpu/obs/) ---------------------------------
+    def set_event_recorder(self, recorder) -> None:
+        """Attach an ``obs.EventRecorder``: one JSONL record per boosting
+        iteration (phase wall times, bag count, grown-tree shape,
+        cumulative collective bytes; eval values arrive via
+        ``callback.log_telemetry``).  ``None`` detaches."""
+        self._telemetry = recorder
+
+    def _note_iter_event(self, it: int, t0: float, tt0, *,
+                         discarded: bool = False) -> None:
+        """Per-iteration telemetry epilogue: close the trace window and
+        note this iteration's host-side fields.  ``tt0`` is the timetag
+        accumulator baseline captured at iteration start (None when the
+        serializing TIMETAG mode is off — then only the honest async wall
+        time is recorded)."""
+        if self._trace is not None:
+            self._trace.iter_end(it, sync=self.train_data.score)
+        rec = self._telemetry
+        if rec is None:
+            return
+        phases = {}
+        if tt0 is not None:
+            now = timetag.get_timings()
+            phases = {k: round(v - tt0.get(k, 0.0), 6)
+                      for k, v in now.items() if v > tt0.get(k, 0.0)}
+        rec.note(it, wall_s=round(time.perf_counter() - t0, 6),
+                 phases=phases, bag_cnt=int(self._bag_cnt),
+                 comm_bytes_cum=int(self._cum_comm_bytes),
+                 comm_calls_cum=int(self._cum_comm_calls))
+        if discarded:
+            # dispatched but undone (the previous iteration saturated);
+            # the reference would never have trained it
+            rec.note(it, discarded=True, trees=[])
+
+    def close_trace(self) -> None:
+        """Stop a trace window the training loop ended inside (otherwise
+        it would keep recording unrelated work until process exit)."""
+        if self._trace is not None:
+            self._trace.close()
 
     def train_one_iter(self, grad=None, hess=None) -> bool:
         """One boosting round (gbdt.cpp:295-382).  Returns True when training
@@ -569,6 +656,16 @@ class GBDT:
             # dispatching — and clear it so a later retry trains afresh
             self._no_more_splits = False
             return True
+        # -- telemetry (obs layer): iteration index, wall clock, optional
+        # timetag baseline for per-phase deltas, trace window entry.  All
+        # gated so the disabled path costs two attribute reads.
+        it = self.iter_
+        rec = self._telemetry
+        t_iter0 = time.perf_counter() if rec is not None else 0.0
+        tt0 = (timetag.get_timings()
+               if rec is not None and timetag.ENABLED else None)
+        if self._trace is not None:
+            self._trace.iter_begin(it)
         # The fused step computes gradients INSIDE the jit and never calls
         # the _gradients / _transform_host_gradients hooks, so it only
         # applies when this instance uses the base implementations of ALL
@@ -652,11 +749,21 @@ class GBDT:
                     tt.sync(vdeltas)
                 cur.append((self._pack_fn(tree_arrays), delta, vdeltas))
         self.iter_ += 1
+        obs.inc("iterations")
+        if self._comm_traffic_totals[1]:
+            # static per-tree collective account × trees dispatched now
+            calls, nbytes = self._comm_traffic_totals
+            self._cum_comm_calls += calls * self.num_class
+            self._cum_comm_bytes += nbytes * self.num_class
+            obs.inc("comm_collective_calls", calls * self.num_class)
+            obs.inc("comm_collective_bytes", nbytes * self.num_class)
         shrink = self.shrinkage_rate
         if not self._pipeline:
             self._pending_iter = cur
+            self._pending_iter_idx = it
             self._pending_shrinkage = shrink
             self._flush_pending()
+            self._note_iter_event(it, t_iter0, tt0)
             if self._no_more_splits:
                 self._no_more_splits = False
                 return True
@@ -673,9 +780,12 @@ class GBDT:
                 for dd, vd in zip(self.valid_data, vds):
                     dd.score = dd.score.at[cls].add(-vd)
             self.iter_ -= 1
+            self._note_iter_event(it, t_iter0, tt0, discarded=True)
             return True
         self._pending_iter = cur
+        self._pending_iter_idx = it
         self._pending_shrinkage = shrink
+        self._note_iter_event(it, t_iter0, tt0)
         return False
 
     def rollback_one_iter(self) -> None:
@@ -791,12 +901,16 @@ class GBDT:
     def train(self, num_iterations: Optional[int] = None) -> None:
         """Application::Train equivalent loop (application.cpp:224-240)."""
         n = num_iterations or self.config.num_iterations
-        for it in range(n):
-            stop = self.train_one_iter()
-            if not stop and (self.valid_data or self.config.is_training_metric):
-                stop = self.eval_and_check_early_stopping() or stop
-            if stop:
-                break
+        try:
+            for it in range(n):
+                stop = self.train_one_iter()
+                if not stop and (self.valid_data
+                                 or self.config.is_training_metric):
+                    stop = self.eval_and_check_early_stopping() or stop
+                if stop:
+                    break
+        finally:
+            self.close_trace()
 
     # ------------------------------------------------------------------
     # Prediction (host entry: raw feature values)

@@ -46,9 +46,18 @@ class GOSS(GBDT):
         warmup = int(1.0 / max(self.config.learning_rate, 1e-12))
         if self.iter_ < warmup:
             self._row_weight = jnp.ones(self.num_data, jnp.float32)
+            self._bag_cnt = self.num_data
             return grad, hess
         mask, grad, hess = self._sample(grad, hess)
         self._row_weight = mask
+        # telemetry: the GOSS draw is this round's "bag" (a*N + b*N rows)
+        top_cnt = int(self.top_rate * self.num_data)
+        other_cnt = int(self.other_rate * self.num_data)
+        kept = top_cnt + other_cnt
+        self._bag_cnt = kept if 0 < top_cnt and kept < self.num_data \
+            else self.num_data
+        from .. import obs
+        obs.inc("bagging_draws")
         return grad, hess
 
     def _bagging_mask(self, iter_):

@@ -1,0 +1,43 @@
+"""Structured run telemetry: always-on counters/gauges, a per-iteration
+JSONL event stream, collective-traffic accounting, and gated device trace
+capture.
+
+The only prior instrument, ``utils/timetag.py``, must *serialize the async
+pipeline* to attribute device time to a phase — a measurement mode that
+cannot stay on during real runs.  This subsystem is the opposite trade,
+in the spirit of XGBoost's GPU monitor counters (Mitchell & Frank,
+arXiv:1806.11248): cheap host-side bookkeeping that is always on, so
+every optimization round has a before/after phase breakdown instead of
+one end-to-end number.  Pieces:
+
+- ``registry``: process-wide monotonic counters (iterations, trees grown,
+  bagging draws, host<->device transfers, collective bytes) and gauges
+  (HBM estimate vs. budget from ``models/gbdt.py estimate_train_memory``).
+  ``snapshot()`` folds in the timetag phase timers when those are enabled.
+- ``events``: per-iteration JSONL records (phase wall times, eval metric
+  values, bag count, grown-tree shape, cumulative collective bytes)
+  written by an ``EventRecorder`` hooked into ``GBDT.train_one_iter``,
+  ``engine.train(events_file=...)`` and ``callback.log_telemetry()``.
+- collective-traffic accounting lives on the comm strategies themselves
+  (``parallel/comm.py`` ``traffic_per_tree``) — static shape math only,
+  nothing added to the jitted path.
+- ``trace``: ``LIGHTGBM_TPU_TRACE_DIR`` (or the ``trace_dir`` config key)
+  wraps a window of boosting iterations in ``jax.profiler`` traces that
+  break down by the ``jax.named_scope`` phases annotated in
+  ``ops/grow.py`` / ``ops/ordered_grow.py``.
+"""
+
+from .events import SCHEMA_VERSION, EventRecorder, read_events  # noqa: F401
+from .phases import (DEVICE_PARENT, DEVICE_PHASES,  # noqa: F401
+                     HOST_PHASES, JITTED_HOST_PHASES)
+from .registry import (REGISTRY, Registry, get_counter,  # noqa: F401
+                       get_gauge, inc, merge, reset, set_gauge, snapshot)
+from .trace import TraceCapture  # noqa: F401
+
+__all__ = [
+    "REGISTRY", "Registry", "inc", "set_gauge", "get_counter", "get_gauge",
+    "snapshot", "merge", "reset",
+    "EventRecorder", "read_events", "SCHEMA_VERSION",
+    "TraceCapture",
+    "HOST_PHASES", "DEVICE_PHASES", "DEVICE_PARENT", "JITTED_HOST_PHASES",
+]

@@ -1,0 +1,122 @@
+"""Per-iteration structured JSONL event stream.
+
+One line per boosting iteration (see docs/OBSERVABILITY.md for the field
+table).  Fields for a given iteration arrive from several producers at
+different times because training is PIPELINED (models/gbdt.py):
+
+- ``GBDT.train_one_iter`` notes wall time, phase deltas, bag count and
+  cumulative collective bytes as iteration *i* is dispatched;
+- the eval callback (``callback.log_telemetry``) notes metric values for
+  *i* after the engine evaluates it;
+- the grown trees' shape for *i* only materializes when the NEXT call
+  flushes the pipelined host transfer (``GBDT._flush_pending``).
+
+The recorder therefore commits on ADVANCE: a record is written out the
+first time any field for a *later* iteration is noted — by then every
+producer of iteration *i* has run (the pipelined flush for *i* happens at
+the start of the device work for *i+1*, and eval callbacks for *i* run
+before ``update(i+1)``).  ``close()`` drains whatever is still pending
+(the final iteration), so callers must flush the booster pipeline before
+closing — ``engine.train`` does this for recorders it owns.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Dict, List
+
+SCHEMA_VERSION = 1
+
+
+def _json_default(o):
+    """Producers hand over numpy scalars (tree depths, counts); coerce
+    instead of burdening every call site."""
+    if hasattr(o, "item"):
+        return o.item()
+    raise TypeError(f"Object of type {type(o).__name__} "
+                    f"is not JSON serializable")
+
+
+def _sanitize(v):
+    """Non-finite metric values (nan auc on a one-class fold, inf loss)
+    would serialize as bare NaN/Infinity tokens — valid for Python's
+    json but rejected by strict consumers (jq, JSON.parse).  Map them to
+    null; the record stays parseable everywhere."""
+    if isinstance(v, float):
+        return v if math.isfinite(v) else None
+    if isinstance(v, dict):
+        return {k: _sanitize(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_sanitize(x) for x in v]
+    return v
+
+
+class EventRecorder:
+    """Append-only JSONL writer with per-iteration field merging."""
+
+    def __init__(self, path: str):
+        self._path = str(path)
+        self._fh = open(self._path, "w")
+        self._pending: Dict[int, Dict[str, Any]] = {}
+        self._written = 0
+
+    # -- producers -------------------------------------------------------
+    def note(self, iteration: int, **fields: Any) -> None:
+        """Merge ``fields`` into iteration ``iteration``'s record.  Dict
+        fields (``eval``, ``phases``) merge key-wise so multiple producers
+        can contribute; scalars are last-write-wins.  Noting any field for
+        an iteration commits every pending record of earlier iterations."""
+        it = int(iteration)
+        rec = self._pending.setdefault(it, {})
+        for key, value in fields.items():
+            if isinstance(value, dict) and isinstance(rec.get(key), dict):
+                rec[key].update(value)
+            else:
+                rec[key] = value
+        for old in sorted(k for k in self._pending if k < it):
+            self._commit(old)
+
+    # -- sink ------------------------------------------------------------
+    def _commit(self, it: int) -> None:
+        rec = self._pending.pop(it)
+        line = {"schema": SCHEMA_VERSION, "iter": it}
+        line.update(rec)
+        self._fh.write(json.dumps(_sanitize(line), default=_json_default)
+                       + "\n")
+        self._fh.flush()
+        self._written += 1
+
+    def close(self) -> None:
+        """Commit all pending records (ascending) and close the file."""
+        if self._fh.closed:
+            return
+        for it in sorted(self._pending):
+            self._commit(it)
+        self._fh.close()
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def path(self) -> str:
+        return self._path
+
+    @property
+    def events_written(self) -> int:
+        return self._written
+
+    def __enter__(self) -> "EventRecorder":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_events(path: str) -> List[Dict[str, Any]]:
+    """Parse an events file back into a list of dicts (schema round-trip)."""
+    out: List[Dict[str, Any]] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

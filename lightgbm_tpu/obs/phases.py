@@ -1,0 +1,48 @@
+"""Canonical phase taxonomies — the single source of truth that
+``tools/lint_phase_scopes.py`` enforces against the code.
+
+Two taxonomies exist because the host and the device see different
+boundaries:
+
+- HOST_PHASES are ``timetag.scope("...")`` names: host wall-clock phases
+  of one boosting round, the reference's TIMETAG taxonomy
+  (gbdt.cpp:20-59 boosting/train_score/valid_score/metric/bagging/tree
+  plus the TPU port's host_tree materialization phase).
+- DEVICE_PHASES are ``jax.named_scope("...")`` names inside the jitted
+  growers (ops/grow.py, ops/ordered_grow.py), the reference's
+  serial_tree_learner.cpp:10-37 taxonomy (hist/find_split/split).  A
+  device trace captured via LIGHTGBM_TPU_TRACE_DIR groups ops by these.
+- DEVICE_PARENT maps each device phase to the host phase whose dispatch
+  contains it, so trace time can be attributed back to the host account.
+- JITTED_HOST_PHASES are the host phases whose time is device work; each
+  must be covered by at least one device phase or traces go dark there.
+
+This module must stay import-free (pure literals): the lint loads it by
+file path without importing the package (and its jax dependency).
+"""
+
+HOST_PHASES = frozenset({
+    "GBDT::boosting",
+    "GBDT::bagging",
+    "GBDT::tree",
+    "GBDT::train_score",
+    "GBDT::valid_score",
+    "GBDT::host_tree",
+    "GBDT::metric",
+})
+
+DEVICE_PHASES = frozenset({
+    "hist",
+    "find_split",
+    "split",
+})
+
+DEVICE_PARENT = {
+    "hist": "GBDT::tree",
+    "find_split": "GBDT::tree",
+    "split": "GBDT::tree",
+}
+
+JITTED_HOST_PHASES = frozenset({
+    "GBDT::tree",
+})

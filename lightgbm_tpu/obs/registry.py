@@ -1,0 +1,109 @@
+"""Process-wide registry of monotonic counters and gauges.
+
+Counters only ever increase (reference points: XGBoost's
+``common::Monitor`` counter dumps, arXiv:1806.11248 §benchmarking);
+gauges record last-written values (live HBM estimate vs. budget).  The
+registry is deliberately process-global, like ``utils/timetag.py``'s
+accumulators: boosters come and go (CV folds, reset_config rebuilds) but
+the run's account persists, and ``merge`` folds a snapshot from another
+process (multi-host runs, fold workers) into this one.
+
+Cost model: one dict update under a lock per call, a handful of calls per
+boosting iteration — cheap enough to leave on unconditionally (the
+acceptance gate for the telemetry layer is "no measurable overhead" on
+bench.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Any, Dict, Mapping, Optional
+
+
+class Registry:
+    """Counters + gauges with snapshot/merge/reset semantics."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = defaultdict(int)
+        self._gauges: Dict[str, Any] = {}
+
+    # -- writers ---------------------------------------------------------
+    def inc(self, name: str, n: int = 1) -> None:
+        """Add ``n`` to counter ``name`` (created at 0)."""
+        with self._lock:
+            self._counters[name] += int(n)
+
+    def set_gauge(self, name: str, value: Any) -> None:
+        """Record the current value of gauge ``name`` (last write wins)."""
+        with self._lock:
+            self._gauges[name] = value
+
+    # -- readers ---------------------------------------------------------
+    def get_counter(self, name: str) -> int:
+        with self._lock:
+            return int(self._counters.get(name, 0))
+
+    def get_gauge(self, name: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._gauges.get(name, default)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Plain-dict view: ``{"counters": .., "gauges": .., "phase_seconds"
+        : ..}``.  Phase timers come from ``utils/timetag`` (empty unless
+        LIGHTGBM_TPU_TIMETAG is on — the serializing measurement mode)."""
+        from ..utils import timetag
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "phase_seconds": timetag.get_timings(),
+            }
+
+    # -- lifecycle -------------------------------------------------------
+    def merge(self, snap: Mapping[str, Any]) -> None:
+        """Fold another registry's ``snapshot()`` in: counters add, gauges
+        last-write-wins (the incoming snapshot is 'newer')."""
+        with self._lock:
+            for name, v in dict(snap.get("counters", {})).items():
+                self._counters[name] += int(v)
+            self._gauges.update(dict(snap.get("gauges", {})))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+
+
+REGISTRY = Registry()
+
+
+# Module-level conveniences bound to the process registry, mirroring the
+# timetag module's free-function surface.
+def inc(name: str, n: int = 1) -> None:
+    REGISTRY.inc(name, n)
+
+
+def set_gauge(name: str, value: Any) -> None:
+    REGISTRY.set_gauge(name, value)
+
+
+def get_counter(name: str) -> int:
+    return REGISTRY.get_counter(name)
+
+
+def get_gauge(name: str, default: Any = None) -> Any:
+    return REGISTRY.get_gauge(name, default)
+
+
+def snapshot() -> Dict[str, Any]:
+    return REGISTRY.snapshot()
+
+
+def merge(snap: Mapping[str, Any]) -> None:
+    REGISTRY.merge(snap)
+
+
+def reset() -> None:
+    REGISTRY.reset()

@@ -1,0 +1,120 @@
+"""Gated device trace capture over a window of boosting iterations.
+
+``LIGHTGBM_TPU_TRACE_DIR=/path`` (or the ``trace_dir`` config key) arms a
+one-shot ``jax.profiler`` trace spanning ``trace_num_iters`` iterations
+starting at ``trace_start_iter`` (default: skip the first 5 so compile
+and warmup don't drown the steady state).  Inside the window the jitted
+growers' ``jax.named_scope`` annotations (obs/phases.py DEVICE_PHASES)
+break device time down by phase without re-running anything — open the
+result in Perfetto (https://ui.perfetto.dev) or TensorBoard's profile
+plugin; see docs/OBSERVABILITY.md.
+
+Unlike LIGHTGBM_TPU_TIMETAG this never serializes the pipeline: the only
+synchronization is one ``block_until_ready`` at window close so the last
+iteration's device work lands inside the capture.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import weakref
+from typing import Optional
+
+from ..utils import log
+
+# One process-wide atexit hook over weakly-held captures: never leave a
+# dangling profiler session, never pin a booster's capture for the
+# process lifetime (CV folds / long-lived embedders build many).
+_ACTIVE: "weakref.WeakSet[TraceCapture]" = weakref.WeakSet()
+
+
+@atexit.register
+def _abort_all() -> None:
+    for tc in list(_ACTIVE):
+        tc.close()
+
+
+class TraceCapture:
+    """One-shot trace window: ``iter_begin``/``iter_end`` from the
+    training loop, ``close()`` when the owning loop finishes (a window
+    the run ended inside is stopped there, not at process exit);
+    start/stop failures degrade to a one-shot warning."""
+
+    def __init__(self, trace_dir: str, start_iter: int = 5,
+                 num_iters: int = 2):
+        self.trace_dir = str(trace_dir)
+        self.start_iter = max(int(start_iter), 0)
+        self.num_iters = max(int(num_iters), 1)
+        self._active = False
+        self._done = False
+        self._started_at = -1
+        _ACTIVE.add(self)
+
+    @classmethod
+    def from_config(cls, config=None) -> Optional["TraceCapture"]:
+        """Build from LIGHTGBM_TPU_TRACE_DIR (wins) or config keys
+        ``trace_dir``/``trace_start_iter``/``trace_num_iters``; None when
+        tracing is not requested."""
+        trace_dir = os.environ.get("LIGHTGBM_TPU_TRACE_DIR", "")
+        start, num = 5, 2
+        if config is not None:
+            trace_dir = trace_dir or str(config.get("trace_dir", "") or "")
+            start = int(config.get("trace_start_iter", start))
+            num = int(config.get("trace_num_iters", num))
+        if not trace_dir:
+            return None
+        return cls(trace_dir, start, num)
+
+    # -- window ----------------------------------------------------------
+    def iter_begin(self, it: int) -> None:
+        if self._done or self._active or it < self.start_iter:
+            return
+        import jax
+        try:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+        except Exception as e:  # pragma: no cover - backend-dependent
+            self._done = True
+            log.warn_once("obs_trace_start",
+                          "device trace capture failed to start: %s", e)
+            return
+        self._active = True
+        self._started_at = it
+        log.info("telemetry: device trace started at iteration %d -> %s",
+                 it, self.trace_dir)
+
+    def iter_end(self, it: int, sync=None) -> None:
+        """Close the window once ``num_iters`` iterations are inside it
+        (counted from where it actually STARTED — continued training may
+        resume past start_iter); blocks on ``sync`` first so the async
+        device work of the final iteration is captured, not cut off."""
+        if not self._active or it + 1 < self._started_at + self.num_iters:
+            return
+        if sync is not None:
+            import jax
+            try:
+                jax.block_until_ready(sync)
+            except Exception:  # pragma: no cover
+                pass
+        self._stop()
+
+    # -- teardown --------------------------------------------------------
+    def _stop(self) -> None:
+        import jax
+        try:
+            jax.profiler.stop_trace()
+            log.info("telemetry: device trace written to %s", self.trace_dir)
+        except Exception as e:  # pragma: no cover - backend-dependent
+            log.warn_once("obs_trace_stop",
+                          "device trace capture failed to stop: %s", e)
+        self._active = False
+        self._done = True
+
+    def close(self) -> None:
+        """Stop recording now if a window is still open (the run ended
+        before ``num_iters`` iterations passed) and retire the capture.
+        Idempotent."""
+        if self._active:
+            self._stop()
+        self._done = True

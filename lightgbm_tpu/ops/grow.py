@@ -80,6 +80,13 @@ class SerialComm(NamedTuple):
     def reduce_sums(self, sums):
         return sums
 
+    def traffic_per_tree(self, num_features: int, max_bin: int,
+                         num_leaves: int):
+        """Collective-traffic account (obs layer): serial growth issues no
+        collectives.  Same interface as the distributed strategies in
+        lightgbm_tpu/parallel/comm.py."""
+        return {}
+
     # -- per-tree preparation -------------------------------------------
     def prepare(self, bins, bins_rm, g, h, w, params: "GrowParams"):
         if not self.leaf_cache:

@@ -244,42 +244,48 @@ def grow_tree_ordered(bins, num_bin, is_cat, feat_mask, grad, hess,
     def make_branch(P: int):
         def branch(ops):
             (bins_w, dig_w, row_ord, s, c, feat, tbin, cat, do_split) = ops
-            win_b = tuple(jax.lax.dynamic_slice(bw, (s,), (P,))
-                          for bw in bins_w)
-            win_d = tuple(jax.lax.dynamic_slice(dw, (s,), (P,))
-                          for dw in dig_w)
-            win_r = jax.lax.dynamic_slice(row_ord, (s,), (P,))
+            # TIMETAG phase names (serial_tree_learner.cpp:10-37) as trace
+            # annotations, mirroring ops/grow.py's cached learner: device
+            # traces captured via LIGHTGBM_TPU_TRACE_DIR group by these.
+            with jax.named_scope("split"):
+                win_b = tuple(jax.lax.dynamic_slice(bw, (s,), (P,))
+                              for bw in bins_w)
+                win_d = tuple(jax.lax.dynamic_slice(dw, (s,), (P,))
+                              for dw in dig_w)
+                win_r = jax.lax.dynamic_slice(row_ord, (s,), (P,))
 
-            word = feat // 4
-            byte = feat % 4
-            # dynamic word pick as a select chain (a lax.switch here costs
-            # 7 branch bodies x 8 size classes of compile time)
-            col32 = win_b[0]
-            for i in range(1, W):
-                col32 = jnp.where(word == i, win_b[i], col32)
-            fcol = (col32 >> (8 * byte)) & 0xFF
-            go_r = jnp.where(cat, fcol != tbin, fcol > tbin)
-            iota = jnp.arange(P, dtype=jnp.int32)
-            inseg = iota < c
-            # key 2 freezes: suffix rows (other segments / tail pad) and
-            # everything when the split is rejected (identity permutation)
-            key = jnp.where(do_split & inseg,
-                            go_r.astype(jnp.uint8), jnp.uint8(2))
+                word = feat // 4
+                byte = feat % 4
+                # dynamic word pick as a select chain (a lax.switch here
+                # costs 7 branch bodies x 8 size classes of compile time)
+                col32 = win_b[0]
+                for i in range(1, W):
+                    col32 = jnp.where(word == i, win_b[i], col32)
+                fcol = (col32 >> (8 * byte)) & 0xFF
+                go_r = jnp.where(cat, fcol != tbin, fcol > tbin)
+                iota = jnp.arange(P, dtype=jnp.int32)
+                inseg = iota < c
+                # key 2 freezes: suffix rows (other segments / tail pad)
+                # and everything when the split is rejected (identity
+                # permutation)
+                key = jnp.where(do_split & inseg,
+                                go_r.astype(jnp.uint8), jnp.uint8(2))
 
-            operands = (key,) + win_b + win_d + (win_r,)
-            sorted_ops = jax.lax.sort(operands, num_keys=1, is_stable=True)
-            sb = sorted_ops[1:1 + W]
-            sd = sorted_ops[1 + W:1 + W + DW]
-            sr = sorted_ops[-1]
+                operands = (key,) + win_b + win_d + (win_r,)
+                sorted_ops = jax.lax.sort(operands, num_keys=1,
+                                          is_stable=True)
+                sb = sorted_ops[1:1 + W]
+                sd = sorted_ops[1 + W:1 + W + DW]
+                sr = sorted_ops[-1]
 
-            bins_w = tuple(jax.lax.dynamic_update_slice(bw, nb, (s,))
-                           for bw, nb in zip(bins_w, sb))
-            dig_w = tuple(jax.lax.dynamic_update_slice(dw, nd, (s,))
-                          for dw, nd in zip(dig_w, sd))
-            row_ord = jax.lax.dynamic_update_slice(row_ord, sr, (s,))
+                bins_w = tuple(jax.lax.dynamic_update_slice(bw, nb, (s,))
+                               for bw, nb in zip(bins_w, sb))
+                dig_w = tuple(jax.lax.dynamic_update_slice(dw, nd, (s,))
+                              for dw, nd in zip(dig_w, sd))
+                row_ord = jax.lax.dynamic_update_slice(row_ord, sr, (s,))
 
-            cnt_r = jnp.sum((go_r & inseg).astype(jnp.int32))
-            cnt_l = c - cnt_r
+                cnt_r = jnp.sum((go_r & inseg).astype(jnp.int32))
+                cnt_l = c - cnt_r
 
             # smaller child's histogram from its CONTIGUOUS slice; pad to
             # P/8 when the child is small enough (splits are often very
@@ -298,11 +304,12 @@ def grow_tree_ordered(bins, num_bin, is_cat, feat_mask, grad, hess,
 
             P2 = max(P // 2, classes[0] // 2, 4096)
             P8 = max(P // 8, 4096)
-            if P8 < P2:
-                sums_small = jax.lax.cond(scnt <= P8, hist_at(P8),
-                                          hist_at(P2), None)
-            else:
-                sums_small = hist_at(P2)(None)
+            with jax.named_scope("hist"):
+                if P8 < P2:
+                    sums_small = jax.lax.cond(scnt <= P8, hist_at(P8),
+                                              hist_at(P2), None)
+                else:
+                    sums_small = hist_at(P2)(None)
             return bins_w, dig_w, row_ord, cnt_l, small_left, sums_small
         return branch
 
@@ -370,24 +377,27 @@ def grow_tree_ordered(bins, num_bin, is_cat, feat_mask, grad, hess,
                             jnp.where(do_split, new_node, empty_node))
 
         # --- child histograms via exact sibling subtraction -------------
-        sums_parent = cache[best_leaf]
-        sums_large = sums_parent - sums_small
-        sums_left = jnp.where(small_left, sums_small, sums_large)
-        sums_right = jnp.where(small_left, sums_large, sums_small)
-        cache = cache.at[best_leaf].set(
-            jnp.where(do_split, sums_left, sums_parent))
-        cache = cache.at[right_leaf].set(
-            jnp.where(do_split, sums_right, cache[right_leaf]), mode="drop")
+        with jax.named_scope("hist"):
+            sums_parent = cache[best_leaf]
+            sums_large = sums_parent - sums_small
+            sums_left = jnp.where(small_left, sums_small, sums_large)
+            sums_right = jnp.where(small_left, sums_large, sums_small)
+            cache = cache.at[best_leaf].set(
+                jnp.where(do_split, sums_left, sums_parent))
+            cache = cache.at[right_leaf].set(
+                jnp.where(do_split, sums_right, cache[right_leaf]),
+                mode="drop")
 
-        hists = leafhist.combine_digit_sums(
-            jnp.stack([sums_left, sums_right]), scales)
-        child_depth_ok = jnp.logical_or(params.max_depth <= 0,
-                                        depth + 1 < params.max_depth)
-        can = jnp.stack([do_split & child_depth_ok] * 2)
-        child_split = find_best_split(
-            hists, jnp.stack([left_g, right_g]),
-            jnp.stack([left_h, right_h]), jnp.stack([left_c, right_c]),
-            num_bin, is_cat, feat_mask, can, sp)
+        with jax.named_scope("find_split"):
+            hists = leafhist.combine_digit_sums(
+                jnp.stack([sums_left, sums_right]), scales)
+            child_depth_ok = jnp.logical_or(params.max_depth <= 0,
+                                            depth + 1 < params.max_depth)
+            can = jnp.stack([do_split & child_depth_ok] * 2)
+            child_split = find_best_split(
+                hists, jnp.stack([left_g, right_g]),
+                jnp.stack([left_h, right_h]), jnp.stack([left_c, right_c]),
+                num_bin, is_cat, feat_mask, can, sp)
 
         def leaf_rows(ci, tot_g, tot_h, tot_c, val, seg_s, seg_c):
             f32 = jnp.stack([

@@ -1,0 +1,20 @@
+"""shard_map across jax versions.
+
+Newer jax exposes ``jax.shard_map(..., check_vma=...)``; 0.4.x only has
+``jax.experimental.shard_map.shard_map(..., check_rep=...)``.  Both flags
+gate the same replication/varying-manual-axes checking, which this
+codebase disables (the growers' replicated outputs are deterministic by
+construction — every shard grows the identical tree)."""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(fn, *, mesh, in_specs, out_specs):
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)

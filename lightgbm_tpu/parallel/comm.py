@@ -40,6 +40,42 @@ def _psum_tree(x, axis_name):
     return jax.tree.map(lambda a: lax.psum(a, axis_name), x)
 
 
+# ---------------------------------------------------------------------------
+# Collective-traffic accounting (lightgbm_tpu/obs/).
+#
+# Every strategy below also implements ``traffic_per_tree(F, B, L)``: the
+# collective calls and payload bytes ONE tree's growth issues, computed
+# statically from shapes — the jitted path is never touched.  This is
+# exact, not a bound: grow_tree runs a fixed-trip-count fori_loop (L-1
+# steps; saturated steps are masked no-ops that still execute their
+# collectives), so the per-tree comm volume is a pure function of
+# (num_features, max_bin, num_leaves, strategy).
+#
+# "bytes" counts the device-local logical payload handed to each
+# collective call (for all_gather: the local shard's contribution, not
+# the k-times-larger gathered result).  BestSplit is 6 scalar fields
+# (gain/feature/threshold/left_sum_g/left_sum_h/left_count), each its own
+# pytree leaf and hence its own collective call.
+# ---------------------------------------------------------------------------
+
+_SPLITINFO_FIELDS = 6
+_HIST_ITEM = 3 * 4          # <sum_g, sum_h, count> f32 per bin
+
+
+def _traffic(**kinds):
+    """Assemble a {kind: {"calls", "bytes"}} dict, dropping empty kinds."""
+    return {k: {"calls": int(c), "bytes": int(b)}
+            for k, (c, b) in kinds.items() if c}
+
+
+def traffic_totals(traffic):
+    """(total_calls, total_bytes) over a traffic_per_tree dict."""
+    if not traffic:
+        return 0, 0
+    return (sum(v["calls"] for v in traffic.values()),
+            sum(v["bytes"] for v in traffic.values()))
+
+
 def _allgather_combine(split: BestSplit, axis_name: str,
                        num_shards: int) -> BestSplit:
     """Allreduce(SplitInfo::MaxReducer): tiny all_gather + tournament."""
@@ -90,6 +126,28 @@ class DataParallelComm(NamedTuple):
     def reduce_sums(self, sums):
         # Root Allreduce of <count, sum_g, sum_h> (data_parallel:112-139).
         return _psum_tree(sums, self.axis_name)
+
+    def traffic_per_tree(self, num_features: int, max_bin: int,
+                         num_leaves: int):
+        """Static per-tree collective account (see module header).
+
+        reduce_scatter mode: one [*, F_pad, B, 3] psum_scatter per split
+        (the histogram pass over ICI) plus the tiny SplitInfo all_gather
+        tournament; psum mode allreduces the full histogram instead."""
+        steps = max(num_leaves - 1, 0)
+        root_psum = (3, 3 * 4)                  # <g, h, count> scalars
+        if self.hist_reduce == "psum":
+            hist_b = num_features * max_bin * _HIST_ITEM
+            return _traffic(
+                psum=(root_psum[0] + 1 + steps,
+                      root_psum[1] + hist_b * (1 + 2 * steps)))
+        F_pad = num_features + (-num_features) % self.num_shards
+        hist_b = F_pad * max_bin * _HIST_ITEM
+        return _traffic(
+            psum=root_psum,
+            psum_scatter=(1 + steps, hist_b * (1 + 2 * steps)),
+            all_gather=(_SPLITINFO_FIELDS * (1 + steps),
+                        _SPLITINFO_FIELDS * 4 * (1 + 2 * steps)))
 
     def _split_from_hist(self, hist, totals_g, totals_h, totals_c, can,
                          num_bin, is_cat, feat_mask, sp):
@@ -158,6 +216,16 @@ class FeatureParallelComm(NamedTuple):
     def reduce_sums(self, sums):
         return sums  # every shard already holds all rows
 
+    def traffic_per_tree(self, num_features: int, max_bin: int,
+                         num_leaves: int):
+        """Static per-tree collective account: feature-parallel ships ONLY
+        SplitInfos (the Allreduce-max tournament) — zero histogram bytes,
+        the whole point of the strategy."""
+        steps = max(num_leaves - 1, 0)
+        return _traffic(
+            all_gather=(_SPLITINFO_FIELDS * (1 + steps),
+                        _SPLITINFO_FIELDS * 4 * (1 + 2 * steps)))
+
     def _local_meta(self, num_bin, is_cat, feat_mask):
         shard = lax.axis_index(self.axis_name)
         offset = shard * self.f_block
@@ -215,6 +283,22 @@ class VotingParallelComm(NamedTuple):
 
     def reduce_sums(self, sums):
         return _psum_tree(sums, self.axis_name)
+
+    def traffic_per_tree(self, num_features: int, max_bin: int,
+                         num_leaves: int):
+        """Static per-tree collective account: the PV-tree promise made
+        measurable — per elect call, 2 all_gathers of the [C, K] proposal
+        lists plus a psum of only the K elected features' histograms
+        (O(2*top_k*max_bin) instead of O(F*max_bin))."""
+        steps = max(num_leaves - 1, 0)
+        K = min(self.top_k, num_features)
+        hist_b = K * max_bin * _HIST_ITEM        # one candidate leaf's psum
+        # root elect has C=1 candidate leaf, each child elect C=2
+        return _traffic(
+            psum=(3 + 1 + steps,
+                  3 * 4 + hist_b * (1 + 2 * steps)),
+            all_gather=(2 * (1 + steps),
+                        2 * K * 4 * (1 + 2 * steps)))
 
     def _local_sp(self, sp: SplitParams) -> SplitParams:
         # local_tree_config_.min_data_in_leaf /= num_machines_ is C++ INTEGER

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.grow import GrowParams, _grow_tree_impl
+from ._compat import shard_map
 from .comm import DataParallelComm, FeatureParallelComm, VotingParallelComm
 
 
@@ -78,8 +79,8 @@ def make_parallel_grow(mesh: Mesh, mode: str, params: GrowParams,
         def local_fn(b, nb, ic, fm, g, h, w, lr):
             return _grow_tree_impl(b, nb, ic, fm, g, h, w, lr, params, comm)
 
-        sharded = jax.shard_map(local_fn, mesh=mesh, in_specs=in_specs,
-                                out_specs=out_specs, check_vma=False)
+        sharded = shard_map(local_fn, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs)
         tree, leaf_id, delta = sharded(bins, num_bin, is_cat, feat_mask,
                                        grad, hess, row_weight, learning_rate)
         if pad_n:
@@ -87,4 +88,15 @@ def make_parallel_grow(mesh: Mesh, mode: str, params: GrowParams,
             delta = delta[:N]
         return tree, leaf_id, delta
 
+    def traffic_per_tree(num_features: int):
+        """Static per-tree collective traffic of this learner at the given
+        (unpadded) feature count — the comm strategy's own account with
+        the same feature padding the jitted path applies (obs layer)."""
+        pad_f = ((-num_features) % k) if mode == "feature" else 0
+        comm = make_comm(mode, axis_name, k, num_features + pad_f, top_k,
+                         hist_reduce)
+        return comm.traffic_per_tree(num_features + pad_f, params.max_bin,
+                                     params.num_leaves)
+
+    grow.traffic_per_tree = traffic_per_tree
     return grow

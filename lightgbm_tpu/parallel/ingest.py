@@ -178,8 +178,9 @@ def make_psum(mesh: Mesh, axis: str):
         def body(x):
             return jax.lax.psum(x[0], axis)
 
-        return jax.shard_map(body, mesh=mesh, in_specs=P(axis),
-                             out_specs=P(), check_vma=False)(x_stacked)
+        from ._compat import shard_map
+        return shard_map(body, mesh=mesh, in_specs=P(axis),
+                         out_specs=P())(x_stacked)
 
     def exchange(contrib_f64: np.ndarray) -> np.ndarray:
         comp = np.stack([_f64_to_f32x3(c) for c in contrib_f64])  # [k,3,...]

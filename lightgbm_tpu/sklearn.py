@@ -291,6 +291,12 @@ class LGBMModel(_SKBase):
             raise LightGBMError("No booster found, call fit first")
         return self._Booster.feature_importance()
 
+    @property
+    def telemetry_(self) -> Dict[str, Any]:
+        """Run telemetry snapshot (counters/gauges/comm account) from the
+        fitted booster — see Booster.telemetry / docs/OBSERVABILITY.md."""
+        return self.booster_.telemetry()
+
 
 class LGBMRegressor(LGBMModel, _SKRegressor):
 

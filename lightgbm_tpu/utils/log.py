@@ -1,11 +1,29 @@
-"""Leveled logger gated by ``verbose`` (reference include/LightGBM/utils/log.h)."""
+"""Leveled logger gated by ``verbose`` (reference include/LightGBM/utils/log.h).
+
+Two embedder-facing extensions over the reference:
+
+- ``warn_once(key, ...)``: one-shot warning for call sites that fire per
+  dataset / per iteration (the first occurrence is the information; the
+  repeats are noise that drowns real warnings in long runs).
+- an opt-in stdlib ``logging`` bridge: ``enable_stdlib_bridge()`` mirrors
+  every record into a ``logging.Logger`` regardless of ``verbose`` so
+  embedders route/filter/format with their own handlers (the console
+  gate below only controls the stderr print).
+"""
 
 from __future__ import annotations
 
 import sys
+from typing import Optional, Set
 
 _LEVELS = {"fatal": -1, "warning": 0, "info": 1, "debug": 2}
 _current_level = 1
+
+_warned_once: Set[str] = set()
+
+_bridge_logger = None
+# stderr tag -> stdlib logging level
+_STDLIB_LEVELS = {"Fatal": 50, "Warning": 30, "Info": 20, "Debug": 10}
 
 
 def set_verbosity(verbose: int) -> None:
@@ -13,9 +31,26 @@ def set_verbosity(verbose: int) -> None:
     _current_level = int(verbose)
 
 
+def enable_stdlib_bridge(name: str = "lightgbm_tpu"):
+    """Mirror all records into ``logging.getLogger(name)``.  Returns the
+    logger.  Filtering is the embedder's: the bridge forwards every record
+    at its mapped level, independent of ``set_verbosity``."""
+    global _bridge_logger
+    import logging
+    _bridge_logger = logging.getLogger(name)
+    return _bridge_logger
+
+
+def disable_stdlib_bridge() -> None:
+    global _bridge_logger
+    _bridge_logger = None
+
+
 def _emit(tag: str, level: int, msg: str, *args) -> None:
+    text = msg % args if args else msg
+    if _bridge_logger is not None:
+        _bridge_logger.log(_STDLIB_LEVELS.get(tag, 20), "%s", text)
     if level <= _current_level:
-        text = msg % args if args else msg
         print(f"[LightGBM-TPU] [{tag}] {text}", file=sys.stderr, flush=True)
 
 
@@ -31,10 +66,27 @@ def warning(msg: str, *args) -> None:
     _emit("Warning", 0, msg, *args)
 
 
+def warn_once(key: str, msg: str, *args) -> None:
+    """Emit ``warning(msg, *args)`` the first time ``key`` is seen in this
+    process; drop repeats.  Use a stable key (parameter name, call site),
+    not the formatted message, so reworded repeats still dedupe."""
+    if key in _warned_once:
+        return
+    _warned_once.add(key)
+    warning(msg, *args)
+
+
+def reset_warn_once() -> None:
+    """Forget warn_once history (tests / long-lived embedders)."""
+    _warned_once.clear()
+
+
 class LightGBMError(Exception):
     """Raised where the reference would Log::Fatal."""
 
 
 def fatal(msg: str, *args) -> None:
     text = msg % args if args else msg
+    if _bridge_logger is not None:
+        _bridge_logger.log(_STDLIB_LEVELS["Fatal"], "%s", text)
     raise LightGBMError(text)

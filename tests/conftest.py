@@ -24,6 +24,26 @@ import os  # noqa: E402
 
 import pytest  # noqa: E402
 
+_terminal_reporter = None
+
+
+def pytest_configure(config):
+    global _terminal_reporter
+    _terminal_reporter = config.pluginmanager.getplugin("terminalreporter")
+
+
+def pytest_runtest_logreport(report):
+    """The tier-1 harness greps progress dots from a piped log; piped
+    stdout is block-buffered, so a timeout kill silently drops every
+    completed test still in the buffer.  Flush after each test so the
+    log reflects what actually ran."""
+    if report.when != "teardown" or _terminal_reporter is None:
+        return
+    try:
+        _terminal_reporter._tw._file.flush()
+    except Exception:
+        pass
+
 
 def pytest_collection_modifyitems(config, items):
     """Tests driving the reference's example data need the read-only

@@ -58,6 +58,8 @@ def test_within_budget_trains(monkeypatch):
 
 
 def test_histogram_pool_size_warns_loudly(capsys, monkeypatch):
+    from lightgbm_tpu.utils import log
+    log.reset_warn_once()   # the warning is one-shot per process now
     ds = _tiny_dataset()
     monkeypatch.delenv("LGBT_DEVICE_MEMORY_BYTES", raising=False)
     cfg = Config({"objective": "binary", "num_leaves": 255, "max_bin": 32,

@@ -1,0 +1,41 @@
+"""tools/lint_phase_scopes.py as a tier-1 test: the host timetag phase
+taxonomy and the device named_scope taxonomy must both match
+lightgbm_tpu/obs/phases.py, so the two accounts can't silently drift."""
+
+import importlib.util
+import pathlib
+
+
+def _load_lint():
+    path = (pathlib.Path(__file__).resolve().parent.parent
+            / "tools" / "lint_phase_scopes.py")
+    spec = importlib.util.spec_from_file_location("lint_phase_scopes", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_phase_taxonomies_in_sync():
+    assert _load_lint().check() == []
+
+
+def test_lint_catches_undeclared_scope(tmp_path, monkeypatch):
+    """Sanity: a scope name outside the taxonomy is reported."""
+    lint = _load_lint()
+    pkg = tmp_path / "lightgbm_tpu"
+    (pkg / "obs").mkdir(parents=True)
+    (pkg / "ops").mkdir()
+    real_phases = (pathlib.Path(lint.__file__).resolve().parent.parent
+                   / "lightgbm_tpu" / "obs" / "phases.py")
+    (pkg / "obs" / "phases.py").write_text(real_phases.read_text())
+    (pkg / "models.py").write_text(
+        'with timetag.scope("GBDT::rogue"):\n    pass\n')
+    (pkg / "ops" / "grow.py").write_text(
+        'with jax.named_scope("hist"):\n    pass\n'
+        'with jax.named_scope("find_split"):\n    pass\n'
+        'with jax.named_scope("split"):\n    pass\n')
+    (pkg / "ops" / "ordered_grow.py").write_text("")
+    monkeypatch.setattr(lint, "ROOT", tmp_path)
+    monkeypatch.setattr(lint, "PKG", pkg)
+    errors = lint.check()
+    assert any("GBDT::rogue" in e for e in errors)

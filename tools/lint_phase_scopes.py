@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Static lint: keep the host (timetag) and device (named_scope) phase
+taxonomies from drifting apart.
+
+``utils/timetag.py`` accumulates host wall-clock under
+``timetag.scope("GBDT::x")`` names; the jitted growers annotate device
+ops with ``jax.named_scope("x")`` so LIGHTGBM_TPU_TRACE_DIR traces break
+down by phase.  The two taxonomies only stay joinable (trace time
+attributed back to the host account) if both match the declarations in
+``lightgbm_tpu/obs/phases.py``.  Checks:
+
+1. every ``timetag.scope("X")`` literal under lightgbm_tpu/ is declared
+   in HOST_PHASES, and every declared host phase is used in code;
+2. every ``jax.named_scope("X")`` in the jitted growers (ops/grow.py,
+   ops/ordered_grow.py) is declared in DEVICE_PHASES, and vice versa;
+3. DEVICE_PARENT maps every device phase onto a declared host phase, and
+   every JITTED_HOST_PHASE is covered by at least one device phase —
+   a rename on either side fails here instead of silently splitting the
+   accounts.
+
+Runs standalone (``python tools/lint_phase_scopes.py``) and as a tier-1
+test (tests/test_phase_lint.py).  phases.py is loaded by file path so
+the lint never imports the package (or jax).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import pathlib
+import re
+import sys
+from typing import Dict, List
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PKG = ROOT / "lightgbm_tpu"
+
+SCOPE_RE = re.compile(r"timetag\.scope\(\s*[\"']([^\"']+)[\"']")
+NAMED_RE = re.compile(r"jax\.named_scope\(\s*[\"']([^\"']+)[\"']")
+
+# the jitted growth paths carrying the device taxonomy
+DEVICE_FILES = ("ops/grow.py", "ops/ordered_grow.py")
+
+
+def _load_phases():
+    spec = importlib.util.spec_from_file_location(
+        "lightgbm_tpu_obs_phases", PKG / "obs" / "phases.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _scan(paths, rx) -> Dict[str, List[str]]:
+    found: Dict[str, List[str]] = {}
+    for p in paths:
+        for m in rx.finditer(p.read_text()):
+            found.setdefault(m.group(1), []).append(
+                str(p.relative_to(ROOT)))
+    return found
+
+
+def check() -> List[str]:
+    """Return a list of violations (empty == clean)."""
+    phases = _load_phases()
+    errors: List[str] = []
+
+    # obs/ declares the taxonomy (docstrings mention the call forms); it
+    # is not a scope *user*
+    host_files = [p for p in sorted(PKG.rglob("*.py"))
+                  if "obs" not in p.relative_to(PKG).parts]
+    host_used = _scan(host_files, SCOPE_RE)
+    for name, sites in sorted(host_used.items()):
+        if name not in phases.HOST_PHASES:
+            errors.append(
+                f"timetag.scope({name!r}) in {sites} is not declared in "
+                f"obs/phases.py HOST_PHASES")
+    for name in sorted(phases.HOST_PHASES - set(host_used)):
+        errors.append(
+            f"HOST_PHASES declares {name!r} but no timetag.scope uses it")
+
+    dev_used = _scan([PKG / f for f in DEVICE_FILES], NAMED_RE)
+    for name, sites in sorted(dev_used.items()):
+        if name not in phases.DEVICE_PHASES:
+            errors.append(
+                f"jax.named_scope({name!r}) in {sites} is not declared in "
+                f"obs/phases.py DEVICE_PHASES")
+    for name in sorted(phases.DEVICE_PHASES - set(dev_used)):
+        errors.append(
+            f"DEVICE_PHASES declares {name!r} but no jax.named_scope in "
+            f"{DEVICE_FILES} uses it")
+
+    for name in sorted(phases.DEVICE_PHASES):
+        parent = phases.DEVICE_PARENT.get(name)
+        if parent is None:
+            errors.append(f"DEVICE_PARENT has no mapping for {name!r}")
+        elif parent not in phases.HOST_PHASES:
+            errors.append(
+                f"DEVICE_PARENT maps {name!r} -> {parent!r}, which is not "
+                f"a declared host phase")
+    covered = set(phases.DEVICE_PARENT.values())
+    for name in sorted(phases.JITTED_HOST_PHASES - covered):
+        errors.append(
+            f"jitted host phase {name!r} has no device phase mapped onto "
+            f"it — traces inside it would be unattributable")
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    for e in errors:
+        print(f"lint_phase_scopes: {e}", file=sys.stderr)
+    if not errors:
+        print("lint_phase_scopes: host/device phase taxonomies in sync")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
