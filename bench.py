@@ -97,7 +97,9 @@ def main() -> None:
             booster.train_one_iter()
         jax.block_until_ready(booster.train_data.score)
         rates.append(num_timed / (time.time() - t0))
-    rates.sort()
+    # median() sorts its own copy: `rates` must stay in measurement order
+    # for the stderr `windows=` diagnostic (load drift over time is the
+    # signal a pre-sorted list destroys)
     iters_per_sec = statistics.median(rates)
     base = CPU_REF_ITERS_PER_SEC.get(num_data)
     vs = (iters_per_sec / base) if base else None

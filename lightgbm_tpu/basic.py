@@ -635,6 +635,46 @@ class Booster:
         }
         return snap
 
+    # -- fault tolerance (lightgbm_tpu/snapshot.py) ----------------------
+    def save_snapshot(self, directory: str, evals_result=None,
+                      keep: int = 0, rounds_done=None) -> str:
+        """Write a crash-safe, checksummed training snapshot into
+        ``directory`` (atomic tmp + ``os.replace``) and return its path.
+        ``engine.train`` does this automatically under
+        ``snapshot_freq``/``snapshot_dir``; this is the manual hook for
+        custom ``update()`` loops.  See docs/FAULT_TOLERANCE.md.
+
+        ``rounds_done`` defaults to the booster's successful iteration
+        count.  An ``engine.train`` resume treats it as the number of
+        boosting-loop rounds already consumed — the two agree unless
+        rounds were dropped (``nan_policy=skip_tree``, saturation); when
+        snapshotting from a callback in such a run, pass the engine's
+        ``env.iteration + 1`` explicitly so resume does not re-attempt
+        the dropped slots."""
+        from .snapshot import save_snapshot
+        gb = self._booster
+        gb._flush_pending()
+        if rounds_done is None:
+            rounds_done = gb.iter_ - gb.num_init_iteration
+        return save_snapshot(directory, self, int(rounds_done),
+                             evals_result=evals_result, keep=keep)
+
+    def restore_snapshot(self, directory_or_state) -> int:
+        """Restore this (freshly built, same params/data) booster from a
+        snapshot directory's newest valid file, or from an already-read
+        state dict.  Returns the number of completed boosting rounds.
+        Raises ``LightGBMError`` when a directory holds no valid
+        snapshot or the snapshot's config fingerprint mismatches."""
+        from .snapshot import load_latest_snapshot, restore_booster_state
+        state = directory_or_state
+        if isinstance(state, str):
+            found = load_latest_snapshot(state)
+            if found is None:
+                raise LightGBMError(
+                    f"no valid snapshot found in {directory_or_state!r}")
+            _, state = found
+        return restore_booster_state(self, state)
+
     # -- introspection ---------------------------------------------------
     def feature_name(self) -> List[str]:
         return list(self._booster.feature_names)

@@ -16,6 +16,15 @@ record per boosting iteration (phase timings, eval values, tree shape,
 cumulative collective bytes — lightgbm_tpu/obs/, docs/OBSERVABILITY.md);
 ``--trace-dir`` (or LIGHTGBM_TPU_TRACE_DIR) captures a device trace over
 a window of iterations.
+
+Fault tolerance (docs/FAULT_TOLERANCE.md): ``snapshot_dir=<dir>
+snapshot_freq=<K>`` (alias ``save_period``, reference CLI convention)
+checkpoints the full training state every K iterations; re-running the
+SAME command after a crash auto-resumes bit-exactly from the newest
+valid snapshot (engine.train owns both halves, so conf files and the
+Python API get identical behavior).  ``nan_policy=fail_fast|skip_tree``
+contains non-finite gradients/scores instead of silently corrupting the
+model.
 """
 
 from __future__ import annotations
@@ -101,7 +110,9 @@ def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv:
         print("usage: python -m lightgbm_tpu config=<conf> [key=value ...] "
-              "[--events-file=<jsonl>] [--trace-dir=<dir>]")
+              "[--events-file=<jsonl>] [--trace-dir=<dir>] "
+              "[snapshot_dir=<dir> snapshot_freq=<K>] "
+              "[nan_policy=fail_fast|skip_tree]")
         return 1
     params = parse_cli_args(argv)
     config = Config(params)

@@ -83,6 +83,7 @@ PARAM_ALIASES: Dict[str, str] = {
     "categorical_feature": "categorical_column",
     "cat_column": "categorical_column",
     "cat_feature": "categorical_column",
+    "save_period": "snapshot_freq",
     "predict_raw_score": "is_predict_raw_score",
     "predict_leaf_index": "is_predict_leaf_index",
     "raw_score": "is_predict_raw_score",
@@ -190,6 +191,13 @@ _DEFAULTS: Dict[str, Any] = {
     # TPU-specific extensions (no reference equivalent)
     "tpu_histogram_impl": "auto",  # auto | scatter | onehot | pallas
     "tpu_double_hist": False,      # float64 histogram accumulation (CPU tests)
+    # fault tolerance (lightgbm_tpu/snapshot.py, docs/FAULT_TOLERANCE.md)
+    "snapshot_freq": 0,        # checkpoint every K iterations (0 = off)
+    "snapshot_dir": "",        # where snapshots live; also enables resume
+    "snapshot_keep": 3,        # newest files retained (0 = keep all)
+    "nan_policy": "none",      # none | fail_fast | skip_tree
+    "distributed_init_retries": 3,    # coordinator-connect retries
+    "distributed_init_backoff": 2.0,  # first retry delay, seconds (x2 each)
     # observability (lightgbm_tpu/obs/; docs/OBSERVABILITY.md)
     "events_file": "",         # per-iteration JSONL event stream path
     "trace_dir": "",           # device trace dir (LIGHTGBM_TPU_TRACE_DIR wins)
@@ -328,6 +336,12 @@ class Config:
         if v["serial_grow"] not in ("ordered", "cached"):
             raise ValueError(
                 f"Unknown serial_grow strategy {v['serial_grow']}")
+        if v["nan_policy"] not in ("none", "fail_fast", "skip_tree"):
+            raise ValueError(
+                f"Unknown nan_policy {v['nan_policy']} "
+                "(expected none, fail_fast, or skip_tree)")
+        if v["snapshot_freq"] < 0:
+            raise ValueError("snapshot_freq must be >= 0")
         # num_machines here means mesh devices; 1 device => normalize back to
         # serial like the reference (config.cpp:161-172).
         if v["num_machines"] <= 1:

@@ -34,9 +34,42 @@ def train(params, train_set, num_boost_round=100,
     ``events_file`` (or the ``events_file`` params key / CLI
     ``--events-file``) streams one JSONL telemetry record per boosting
     iteration — phase timings, eval values, tree shape, cumulative
-    collective bytes (lightgbm_tpu/obs/, docs/OBSERVABILITY.md)."""
+    collective bytes (lightgbm_tpu/obs/, docs/OBSERVABILITY.md).
+
+    ``snapshot_dir`` + ``snapshot_freq`` params make the run crash-safe
+    (docs/FAULT_TOLERANCE.md): every K iterations the full booster state
+    is checkpointed atomically, and a later call with the same
+    ``snapshot_dir`` auto-resumes from the newest valid snapshot,
+    bit-exactly — corrupt/partial snapshot files are detected by
+    checksum and fall back to the previous one."""
     params = dict(params or {})
     events_file = events_file or params.get("events_file") or None
+    # -- crash-safe snapshot/resume (lightgbm_tpu/snapshot.py) ----------
+    snapshot_dir = str(params.get("snapshot_dir") or "") or None
+    try:
+        snapshot_freq = int(params.get("snapshot_freq", 0) or 0)
+    except (TypeError, ValueError):
+        raise ValueError(f"snapshot_freq={params['snapshot_freq']!r} "
+                         "is not an integer")
+    try:
+        snapshot_keep = int(params.get("snapshot_keep", 3) or 0)
+    except (TypeError, ValueError):
+        snapshot_keep = 3
+    if snapshot_freq > 0 and not snapshot_dir:
+        log.warning("snapshot_freq=%d but no snapshot_dir given; "
+                    "snapshots are DISABLED", snapshot_freq)
+    resume_state = None
+    if snapshot_dir:
+        from .snapshot import load_latest_snapshot
+        found = load_latest_snapshot(snapshot_dir)
+        if found is not None:
+            resume_path, resume_state = found
+            if init_model is not None:
+                log.warning("snapshot %s takes precedence over "
+                            "init_model for resume", resume_path)
+                init_model = None
+            log.info("Resuming from snapshot %s (%d rounds done)",
+                     resume_path, int(resume_state.get("rounds_done", 0)))
     if fobj is not None:
         params["objective"] = "none"
     for alias in ("num_boost_round", "num_iterations", "num_iteration",
@@ -98,6 +131,28 @@ def train(params, train_set, num_boost_round=100,
     for vs, name in zip(reduced_valid_sets, name_valid_sets):
         booster.add_valid(vs, name)
 
+    # Apply the resume state AFTER valid sets are attached so their
+    # saved score caches land on the right _DeviceData buffers (the
+    # replays above ran against an empty model and were no-ops).
+    resume_done = 0
+    if resume_state is not None:
+        from .snapshot import restore_booster_state
+        resume_done = restore_booster_state(booster, resume_state)
+        init_iteration = booster._booster.num_init_iteration
+        if early_stopping_rounds is not None:
+            # the callback's best-score baseline is closure state the
+            # snapshot cannot reach: it re-arms from the resume point, so
+            # a run that would have early-stopped may run longer
+            log.warning("resuming with early_stopping_rounds=%d: the "
+                        "early-stopping counter restarts at iteration %d "
+                        "(its pre-crash best-score baseline is not part "
+                        "of the snapshot)", early_stopping_rounds,
+                        init_iteration + resume_done)
+        if resume_done >= num_boost_round:
+            log.warning("snapshot already holds %d rounds >= "
+                        "num_boost_round=%d; nothing left to train",
+                        resume_done, num_boost_round)
+
     # telemetry event stream (lightgbm_tpu/obs/): the recorder is owned
     # here — attached to the booster for per-iteration notes, fed eval
     # values by log_telemetry, drained+closed after the loop.
@@ -130,9 +185,17 @@ def train(params, train_set, num_boost_round=100,
     callbacks_after = sorted(callbacks_after,
                              key=lambda cb: getattr(cb, "order", 0))
 
+    # resumed eval history: restore AFTER record_evaluation's factory
+    # cleared the dict, so the resumed run's evals_result continues the
+    # interrupted one seamlessly
+    if resume_state is not None and evals_result is not None \
+            and resume_state.get("evals_result"):
+        evals_result.update(copy.deepcopy(resume_state["evals_result"]))
+
     # boosting loop (engine.py:143-203)
     try:
-        for i in range(init_iteration, init_iteration + num_boost_round):
+        for i in range(init_iteration + resume_done,
+                       init_iteration + num_boost_round):
             for cb in callbacks_before:
                 cb(callback.CallbackEnv(model=booster, params=params,
                                         iteration=i,
@@ -157,6 +220,13 @@ def train(params, train_set, num_boost_round=100,
             except callback.EarlyStopException as e:
                 booster.best_iteration = e.best_iteration + 1
                 break
+            if snapshot_dir and snapshot_freq > 0 \
+                    and (i + 1 - init_iteration) % snapshot_freq == 0:
+                from .snapshot import save_snapshot
+                save_snapshot(snapshot_dir, booster,
+                              rounds_done=i + 1 - init_iteration,
+                              evals_result=evals_result,
+                              keep=snapshot_keep)
             if finished:
                 # No leaf met the split requirements: the model is saturated
                 # and further rounds would re-do full histogram work for

@@ -74,6 +74,30 @@ def estimate_train_memory(num_data: int, num_features: int, num_leaves: int,
     }
 
 
+def estimate_valid_memory(num_data: int, num_features: int,
+                          num_models: int,
+                          bin_itemsize: int = 1) -> Dict[str, int]:
+    """Per-device HBM footprint (bytes) of ATTACHING a validation set.
+
+    A valid set allocates a column-major device bin matrix and a
+    per-class f32 score buffer (``_DeviceData`` with
+    ``with_row_major=False``); replaying/scoring holds one per-class
+    prediction delta live on top.  Counted separately from
+    ``estimate_train_memory`` so ``add_valid_dataset`` can fail fast
+    instead of dying in a late XLA allocation when the valid set is
+    attached after training state already fills the device."""
+    n = num_data
+    bins = n * num_features * bin_itemsize
+    scores = num_models * n * 4
+    working = n * 4                 # one class's delta during replay/score
+    return {
+        "bins_device": bins,
+        "scores": scores,
+        "working": working,
+        "total": bins + scores + working,
+    }
+
+
 def _device_memory_limit() -> Optional[int]:
     """Per-device memory budget in bytes, or None when unknown.
 
@@ -147,6 +171,18 @@ class _DeviceData:
         self.score = self.score.at[cls].add(delta)
 
 
+@jax.jit
+def _all_finite(*arrays):
+    """One device scalar: every element of every array is finite.  The
+    NaN/Inf containment guard (``nan_policy``) reads this per iteration;
+    the reduction is jitted and cheap, but *reading* it synchronizes the
+    async pipeline — which is why the guard is opt-in."""
+    ok = jnp.asarray(True)
+    for a in arrays:
+        ok = ok & jnp.isfinite(a).all()
+    return ok
+
+
 @functools.partial(jax.jit, static_argnames=("n", "bag_cnt"))
 def _device_bag_mask(key, n: int, bag_cnt: int):
     """EXACT-count sample without replacement (reference bag_data_cnt_).
@@ -197,6 +233,9 @@ class GBDT:
     _cum_comm_calls = 0
     _bag_cnt = 0                  # rows in the current bagging draw
     _pending_iter_idx = -1        # iteration index of _pending_iter
+    # -- fault tolerance (docs/FAULT_TOLERANCE.md) ----------------------
+    _nan_policy = "none"          # none | fail_fast | skip_tree
+    _nan_skips = 0                # poisoned iterations dropped (skip_tree)
 
     def __init__(self, config: Config, train_set: Optional[BinnedDataset],
                  objective: Optional[ObjectiveFunction] = None):
@@ -238,6 +277,8 @@ class GBDT:
         self.train_metrics = self._make_metrics(cfg, train_set)
 
         self._trace = obs.TraceCapture.from_config(cfg)
+        self._nan_policy = str(getattr(cfg, "nan_policy", "none") or "none")
+        self._nan_skips = 0
         self._bag_cnt = self.num_data
         self._bag_key = jax.random.PRNGKey(cfg.bagging_seed)
         self._feature_rng = np.random.RandomState(cfg.feature_fraction_seed)
@@ -278,6 +319,9 @@ class GBDT:
                 "shrink the cache", pool_mb,
                 est["histogram_cache"] / (1 << 20), cfg.num_leaves,
                 train_set.num_features, cfg.max_bin)
+        # running account for add_valid_dataset's incremental re-check
+        self._train_mem_est = int(est["total"])
+        self._valid_mem_bytes = 0
         limit = _device_memory_limit()
         obs.set_gauge("hbm_budget_bytes", int(limit) if limit else -1)
         if limit and est["total"] > limit:
@@ -449,6 +493,30 @@ class GBDT:
             # with the train handle as reference)
             log.fatal("Cannot add validation data, since it has different "
                       "bin mappers with training data")
+        # Re-run the fail-fast memory budget with this valid set counted:
+        # the late-attach path is exactly where the original construction
+        # check cannot see the allocation coming and training would die
+        # in an XLA OOM after hours of work.
+        est = estimate_valid_memory(
+            valid_set.num_data, valid_set.num_features, self.num_class,
+            bin_itemsize=valid_set.bins.dtype.itemsize)
+        valid_bytes = getattr(self, "_valid_mem_bytes", 0) + int(est["total"])
+        total = getattr(self, "_train_mem_est", 0) + valid_bytes
+        obs.set_gauge("hbm_total_estimate_bytes", int(total))
+        limit = _device_memory_limit()
+        if limit and total > limit:
+            log.fatal(
+                "attaching this validation set (%d rows: bins=%.0fMB, "
+                "scores=%.0fMB) brings the estimated device footprint to "
+                "%.0fMB, over the budget %.0fMB (training state %.0fMB + "
+                "valid sets %.0fMB).  Evaluate on fewer/smaller valid "
+                "sets, or shrink the training state (num_leaves/max_bin).",
+                valid_set.num_data, est["bins_device"] / (1 << 20),
+                est["scores"] / (1 << 20), total / (1 << 20),
+                limit / (1 << 20),
+                getattr(self, "_train_mem_est", 0) / (1 << 20),
+                valid_bytes / (1 << 20))
+        self._valid_mem_bytes = valid_bytes
         dd = _DeviceData(valid_set, self.num_class)
         # replay existing trees (continued training)
         for i, tree in enumerate(self.models):
@@ -525,17 +593,23 @@ class GBDT:
         bins, num_bin, is_cat = (self.train_data.bins, self.num_bin,
                                  self.is_cat)
         num_class = self.num_class
+        # NaN/Inf containment: the grad/hess finiteness reduction runs
+        # INSIDE the fused jit (the gradients never visit the host), so
+        # the guarded path pays one extra scalar in the transfer — the
+        # ungated path compiles the check away entirely.
+        guard = self._nan_policy != "none"
 
         @jax.jit
         def step_fn(score, feat_masks, row_weight, lr):
             grad, hess = obj_grad(score)
+            ok = (_all_finite(grad, hess) if guard else jnp.asarray(True))
             outs = []
             for cls in range(num_class):
                 ta, _, delta = grow(bins, num_bin, is_cat, feat_masks[cls],
                                     grad[cls], hess[cls], row_weight, lr)
                 score = score.at[cls].add(delta)
                 outs.append((pack_tree_arrays(ta), ta, delta))
-            return score, outs
+            return score, outs, ok
         return step_fn
 
     # -- pipelined host materialization --------------------------------
@@ -583,6 +657,7 @@ class GBDT:
             log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements.")
             self._no_more_splits = True
+            obs.inc("saturated_iterations")
             self.iter_ -= 1
             if shapes is not None:
                 rec.note(pend_idx, saturated=True, trees=shapes)
@@ -688,6 +763,15 @@ class GBDT:
             self._lr_cache = (self.shrinkage_rate,
                               jnp.float32(self.shrinkage_rate))
         lr_dev = self._lr_cache[1]
+        # NaN/Inf containment (nan_policy != "none"): keep handles to the
+        # pre-iteration score arrays — device arrays are immutable, so a
+        # poisoned iteration rolls back by reassignment, no arithmetic
+        # undo (which NaN would defeat: x + NaN - NaN != x).
+        guard = self._nan_policy != "none"
+        poisoned = None               # which check tripped, for diagnostics
+        if guard:
+            score0 = self.train_data.score
+            vscores0 = [dd.score for dd in self.valid_data]
         cur = []
         if fused:
             # standard objective: ONE device dispatch for the whole round
@@ -697,18 +781,26 @@ class GBDT:
                 self._train_step = self._make_train_step()
             feat_masks = self._feature_masks_all()
             with timetag.scope("GBDT::tree") as tt:
-                self.train_data.score, outs = self._train_step(
+                self.train_data.score, outs, gh_ok = self._train_step(
                     self.train_data.score, feat_masks, row_weight, lr_dev)
                 tt.sync(self.train_data.score)
-            for cls, (packed, tree_arrays, delta) in enumerate(outs):
-                vdeltas = []
-                with timetag.scope("GBDT::valid_score") as tt:
-                    for dd in self.valid_data:
-                        vd = self._device_tree_delta(dd, tree_arrays)
-                        dd.score = dd.score.at[cls].add(vd)
-                        vdeltas.append(vd)
-                    tt.sync(vdeltas)
-                cur.append((packed, delta, vdeltas))
+            if guard:
+                ok_gh, ok_sc = jax.device_get(
+                    (gh_ok, _all_finite(self.train_data.score)))
+                if not bool(ok_gh):
+                    poisoned = "gradients/hessians"
+                elif not bool(ok_sc):
+                    poisoned = "scores"
+            if poisoned is None:
+                for cls, (packed, tree_arrays, delta) in enumerate(outs):
+                    vdeltas = []
+                    with timetag.scope("GBDT::valid_score") as tt:
+                        for dd in self.valid_data:
+                            vd = self._device_tree_delta(dd, tree_arrays)
+                            dd.score = dd.score.at[cls].add(vd)
+                            vdeltas.append(vd)
+                        tt.sync(vdeltas)
+                    cur.append((packed, delta, vdeltas))
         else:
             # per-stage path: custom fobj, GOSS-style _gradients hooks, or
             # LGBT_NO_FUSED_STEP.  Gradients BEFORE the bagging mask:
@@ -727,9 +819,14 @@ class GBDT:
                     # objective-agnostic)
                     grad, hess = self._transform_host_gradients(grad, hess)
                 tt.sync((grad, hess))
+            if guard and not bool(_all_finite(grad, hess)):
+                # caught BEFORE growing: the poisoned round skips the
+                # whole tree pass, not just its bookkeeping
+                poisoned = "gradients/hessians"
             with timetag.scope("GBDT::bagging"):
                 row_weight = self._bagging_mask(self.iter_)
-            for cls in range(self.num_class):
+            classes = range(self.num_class) if poisoned is None else ()
+            for cls in classes:
                 feat_mask = self._feature_mask()
                 with timetag.scope("GBDT::tree") as tt:
                     tree_arrays, leaf_id, delta = self._grow_fn(
@@ -748,6 +845,14 @@ class GBDT:
                         vdeltas.append(vd)
                     tt.sync(vdeltas)
                 cur.append((self._pack_fn(tree_arrays), delta, vdeltas))
+            if guard and poisoned is None \
+                    and not bool(_all_finite(self.train_data.score)):
+                # finite gradients can still yield a non-finite tree
+                # (degenerate hessian sums); catch it after the update
+                poisoned = "scores"
+        if poisoned is not None:
+            return self._contain_poisoned_iter(it, poisoned, score0,
+                                               vscores0)
         self.iter_ += 1
         obs.inc("iterations")
         if self._comm_traffic_totals[1]:
@@ -788,6 +893,39 @@ class GBDT:
         self._note_iter_event(it, t_iter0, tt0)
         return False
 
+    def _contain_poisoned_iter(self, it: int, what: str, score0,
+                               vscores0) -> bool:
+        """NaN/Inf containment (``nan_policy``): a check tripped for
+        iteration ``it``.  Roll the score caches back to their
+        pre-iteration arrays, record the event, then either die with a
+        real diagnostic (``fail_fast``) or drop the round and continue
+        (``skip_tree``).  The dropped round's dispatched device work is
+        simply discarded — nothing was committed to ``models``.  Always
+        returns False (training continues) on the skip path; the next
+        call re-attempts the same iteration index."""
+        self.train_data.score = score0
+        for dd, s0 in zip(self.valid_data, vscores0):
+            dd.score = s0
+        obs.inc("nan_iterations_dropped")
+        rec = self._telemetry
+        if rec is not None:
+            rec.note(it, nan_poisoned=what, nan_policy=self._nan_policy)
+        if self._trace is not None:
+            self._trace.iter_end(it, sync=self.train_data.score)
+        obj = getattr(getattr(self, "objective", None), "name", "?")
+        if self._nan_policy == "fail_fast":
+            log.fatal(
+                "non-finite %s at boosting iteration %d (objective=%s).  "
+                "The model up to iteration %d is intact; inspect the "
+                "objective/labels (or a custom fobj), or set "
+                "nan_policy=skip_tree to drop poisoned iterations and "
+                "continue.", what, it, obj, it)
+        self._nan_skips += 1
+        log.warning("nan_policy=skip_tree: dropping boosting iteration %d "
+                    "(non-finite %s, objective=%s); %d iteration(s) "
+                    "dropped so far", it, what, obj, self._nan_skips)
+        return False
+
     def rollback_one_iter(self) -> None:
         """GBDT::RollbackOneIter (gbdt.cpp:384-402)."""
         # Flush BEFORE the iter_ guard: a pending saturated iteration is
@@ -804,6 +942,104 @@ class GBDT:
                 for dd in self.valid_data:
                     self._add_host_tree_to(dd, neg, cls)
         self.iter_ -= 1
+
+    # ------------------------------------------------------------------
+    # Crash-safe snapshot/resume state hooks (lightgbm_tpu/snapshot.py).
+    # Everything ``init_model`` continued training DISCARDS lives here:
+    # score caches, RNG streams, bag state, best-iteration bookkeeping.
+    # Subclasses with extra mutable state (DART drop weights, GOSS
+    # sampling key) extend both hooks.
+
+    def snapshot_state(self) -> Dict:
+        """Full resumable training state, host-side.  Flushes the
+        pipelined iteration first so the captured view is synchronous.
+        Restoring this onto a same-config booster over the same data is
+        bit-exact: scores are saved as arrays (not re-derived by tree
+        replay, which would re-order float additions) and every RNG
+        stream resumes mid-sequence."""
+        if not hasattr(self, "train_set"):
+            log.fatal("snapshot_state requires a training booster "
+                      "(loaded prediction-only models have no "
+                      "resumable state)")
+        self._flush_pending()
+        return {
+            "submodel": self.submodel_name,
+            "fingerprint": {
+                "objective": getattr(self.objective, "name", "?"),
+                "num_class": int(self.num_class),
+                "num_data": int(self.num_data),
+                "num_features": int(self.num_features),
+                "num_leaves": int(self.grow_params.num_leaves),
+            },
+            "models": list(self._models),
+            "iter_": int(self.iter_),
+            "num_init_iteration": int(self.num_init_iteration),
+            "best_iteration": int(self.best_iteration),
+            "best_score": dict(self.best_score),
+            "best_msg": dict(self.best_msg),
+            "shrinkage_rate": float(self.shrinkage_rate),
+            "no_more_splits": bool(self._no_more_splits),
+            "train_score": np.asarray(self.train_data.score),
+            "valid_scores": [np.asarray(dd.score)
+                             for dd in self.valid_data],
+            "bag_key": np.asarray(self._bag_key),
+            "row_weight": np.asarray(self._row_weight),
+            "bag_cnt": int(self._bag_cnt),
+            "feature_rng": self._feature_rng.get_state(),
+            "cum_comm": (int(self._cum_comm_calls),
+                         int(self._cum_comm_bytes)),
+            "nan_skips": int(self._nan_skips),
+        }
+
+    def restore_state(self, state: Dict) -> None:
+        """Inverse of ``snapshot_state``, applied to a freshly built
+        booster (same params, same data).  Valid sets attached before
+        the restore get their saved score caches back by position; any
+        extra valid set (attached on resume but absent from the
+        snapshot) is brought up to date by replaying the restored
+        trees."""
+        if state.get("submodel") != self.submodel_name:
+            log.fatal("snapshot was taken by a %r booster; this run is "
+                      "configured as %r", state.get("submodel"),
+                      self.submodel_name)
+        fp = state.get("fingerprint", {})
+        mine = {
+            "objective": getattr(self.objective, "name", "?"),
+            "num_class": int(self.num_class),
+            "num_data": int(self.num_data),
+            "num_features": int(self.num_features),
+            "num_leaves": int(self.grow_params.num_leaves),
+        }
+        if fp and fp != mine:
+            diff = {k: (fp.get(k), mine[k]) for k in mine
+                    if fp.get(k) != mine[k]}
+            log.fatal("snapshot/config mismatch, refusing to resume "
+                      "(snapshot vs current): %s", diff)
+        self._flush_pending()
+        self._models = list(state["models"])
+        self.iter_ = int(state["iter_"])
+        self.num_init_iteration = int(state["num_init_iteration"])
+        self.best_iteration = int(state["best_iteration"])
+        self.best_score = dict(state["best_score"])
+        self.best_msg = dict(state["best_msg"])
+        self.shrinkage_rate = float(state["shrinkage_rate"])
+        self._no_more_splits = bool(state["no_more_splits"])
+        self.train_data.score = jnp.asarray(state["train_score"])
+        saved_valid = state.get("valid_scores", [])
+        for vi, dd in enumerate(self.valid_data):
+            if vi < len(saved_valid) and \
+                    np.shape(saved_valid[vi]) == np.shape(dd.score):
+                dd.score = jnp.asarray(saved_valid[vi])
+            else:
+                for i, tree in enumerate(self._models):
+                    self._add_host_tree_to(dd, tree, i % self.num_class)
+        self._bag_key = jnp.asarray(state["bag_key"], jnp.uint32)
+        self._row_weight = jnp.asarray(state["row_weight"], jnp.float32)
+        self._bag_cnt = int(state["bag_cnt"])
+        self._feature_rng.set_state(state["feature_rng"])
+        self._cum_comm_calls, self._cum_comm_bytes = \
+            (int(v) for v in state["cum_comm"])
+        self._nan_skips = int(state.get("nan_skips", 0))
 
     # ------------------------------------------------------------------
     def _device_tree_delta(self, dd: _DeviceData, tree_arrays) -> jax.Array:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..utils import log
 from .gbdt import GBDT
@@ -62,6 +63,22 @@ class GOSS(GBDT):
 
     def _bagging_mask(self, iter_):
         return self._row_weight
+
+    # -- crash-safe snapshot/resume (lightgbm_tpu/snapshot.py) -----------
+    # _row_weight/_bag_cnt ride in the base state; only the sampling key
+    # is GOSS-specific (the warmup gate derives from iter_).
+    def snapshot_state(self):
+        state = super().snapshot_state()
+        state["goss"] = {"key": np.asarray(self._goss_key)}
+        return state
+
+    def restore_state(self, state):
+        super().restore_state(state)
+        g = state.get("goss")
+        if g is None:
+            log.fatal("snapshot has no GOSS state; it was not taken from "
+                      "a goss booster")
+        self._goss_key = jnp.asarray(g["key"], jnp.uint32)
 
     def _sample(self, grad, hess):
         n = self.num_data

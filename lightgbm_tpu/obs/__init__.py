@@ -31,12 +31,13 @@ from .events import SCHEMA_VERSION, EventRecorder, read_events  # noqa: F401
 from .phases import (DEVICE_PARENT, DEVICE_PHASES,  # noqa: F401
                      HOST_PHASES, JITTED_HOST_PHASES)
 from .registry import (REGISTRY, Registry, get_counter,  # noqa: F401
-                       get_gauge, inc, merge, reset, set_gauge, snapshot)
+                       get_gauge, inc, merge, reset, restore, set_gauge,
+                       snapshot)
 from .trace import TraceCapture  # noqa: F401
 
 __all__ = [
     "REGISTRY", "Registry", "inc", "set_gauge", "get_counter", "get_gauge",
-    "snapshot", "merge", "reset",
+    "snapshot", "merge", "reset", "restore",
     "EventRecorder", "read_events", "SCHEMA_VERSION",
     "TraceCapture",
     "HOST_PHASES", "DEVICE_PHASES", "DEVICE_PARENT", "JITTED_HOST_PHASES",

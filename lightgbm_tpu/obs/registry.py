@@ -70,6 +70,17 @@ class Registry:
                 self._counters[name] += int(v)
             self._gauges.update(dict(snap.get("gauges", {})))
 
+    def restore(self, snap: Mapping[str, Any]) -> None:
+        """Overwrite this registry's values with a snapshot's (counters
+        AND gauges set, not added).  Crash-safe resume uses this so a
+        fresh process continues the interrupted run's cumulative account
+        (lightgbm_tpu/snapshot.py) — unlike ``merge``, which folds a
+        concurrent worker's snapshot INTO a live account."""
+        with self._lock:
+            for name, v in dict(snap.get("counters", {})).items():
+                self._counters[name] = int(v)
+            self._gauges.update(dict(snap.get("gauges", {})))
+
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
@@ -103,6 +114,10 @@ def snapshot() -> Dict[str, Any]:
 
 def merge(snap: Mapping[str, Any]) -> None:
     REGISTRY.merge(snap)
+
+
+def restore(snap: Mapping[str, Any]) -> None:
+    REGISTRY.restore(snap)
 
 
 def reset() -> None:

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import os
 import socket
+import time
 from typing import List, Optional, Tuple
 
 from ..utils import log
@@ -68,10 +69,19 @@ def find_process_id(machines: List[Tuple[str, int]]) -> Optional[int]:
     override = os.environ.get("LIGHTGBM_TPU_PROCESS_ID")
     if override is not None:
         try:
-            return int(override)
+            pid = int(override)
         except ValueError:
             log.fatal("LIGHTGBM_TPU_PROCESS_ID=%r is not an integer",
                       override)
+        if not 0 <= pid < len(machines):
+            # caught here, with a named cause — not as an opaque
+            # jax.distributed.initialize failure minutes into bring-up
+            log.fatal("LIGHTGBM_TPU_PROCESS_ID=%d is out of range: the "
+                      "machine list has %d entr%s (valid ids 0..%d)",
+                      pid, len(machines),
+                      "y" if len(machines) == 1 else "ies",
+                      len(machines) - 1)
+        return pid
     local = _local_addresses()
     matches = [i for i, (host, _) in enumerate(machines) if host in local]
     if len(matches) > 1:
@@ -108,8 +118,19 @@ def globalize_grow_fn(grow_fn, mesh):
     static_cache = {}
 
     def _promote(a):
-        return jax.make_array_from_callback(
-            np.shape(a), replicated, lambda idx, a=a: np.asarray(a)[idx])
+        # Device-resident args (grad/hess/row_weight/lr: products of the
+        # jitted objective/bagging chain) replicate device-to-device; a
+        # host numpy round-trip here would sync the pipeline AND pay a
+        # PCIe/DCN copy per array per class per iteration.
+        if isinstance(a, jax.Array):
+            try:
+                return jax.device_put(a, replicated)
+            except Exception:
+                # runtimes without cross-process device_put: fall through
+                # to the host path below
+                pass
+        return multihost_utils.host_local_array_to_global_array(
+            np.asarray(a), mesh, PartitionSpec())
 
     def wrapped(*args):
         glob = []
@@ -134,6 +155,63 @@ def globalize_grow_fn(grow_fn, mesh):
         return tree, leaf_id, delta
 
     return wrapped
+
+
+def _is_already_initialized(err: BaseException) -> bool:
+    s = str(err)
+    return "already" in s or "must be called before" in s
+
+
+def initialize_with_retry(coordinator_address: str, num_processes: int,
+                          process_id: int, *, retries: int = 3,
+                          backoff_s: float = 2.0,
+                          timeout_s: float = 0.0) -> bool:
+    """``jax.distributed.initialize`` with exponential backoff.
+
+    Pod bring-up is racy by nature: the coordinator process may start
+    seconds (or a scheduler hiccup) after the workers, and one refused
+    connection must not kill a run that would have succeeded on the next
+    attempt.  Retries ``retries`` times with delays ``backoff_s * 2^k``,
+    bounded by ``timeout_s`` overall (<= 0: no deadline).  Returns True
+    on success (including launcher-already-initialized); exhausting the
+    budget raises a fatal diagnostic naming the coordinator, attempts
+    and last error instead of an opaque runtime traceback."""
+    import jax
+
+    deadline = (time.monotonic() + timeout_s) if timeout_s > 0 else None
+    attempts = max(int(retries), 0) + 1
+    delay = max(float(backoff_s), 0.0)
+    last_err: Optional[BaseException] = None
+    made = 0
+    for attempt in range(attempts):
+        if attempt > 0:
+            if deadline is not None \
+                    and time.monotonic() + delay > deadline:
+                break
+            log.warning("jax.distributed.initialize attempt %d/%d failed "
+                        "(%s); retrying in %.1fs", attempt, attempts,
+                        last_err, delay)
+            time.sleep(delay)
+            delay *= 2
+        made += 1
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+            return True
+        except Exception as e:  # noqa: BLE001 - runtime raises several types
+            if isinstance(e, RuntimeError) and _is_already_initialized(e):
+                log.warning("jax.distributed.initialize skipped: %s", e)
+                return True
+            last_err = e
+    log.fatal(
+        "jax.distributed could not connect to coordinator %s as process "
+        "%d/%d after %d attempt(s): %s.  Check that the first "
+        "machine_list_file entry names a host every worker can reach, "
+        "that the coordinator process is running, and that the port is "
+        "open; raise distributed_init_retries / distributed_init_backoff "
+        "/ time_out for slow pod bring-up.",
+        coordinator_address, process_id, num_processes, made, last_err)
 
 
 def maybe_initialize_distributed(config) -> bool:
@@ -169,13 +247,13 @@ def maybe_initialize_distributed(config) -> bool:
     host, port = machines[0]
     log.info("jax.distributed: coordinator %s:%d, process %d/%d",
              host, port, pid, num_machines)
-    try:
-        jax.distributed.initialize(
-            coordinator_address=f"{host}:{port}",
-            num_processes=num_machines, process_id=pid)
-    except RuntimeError as e:
-        if "already" in str(e) or "must be called before" in str(e):
-            log.warning("jax.distributed.initialize skipped: %s", e)
-            return True
-        raise
+    # reference time_out is minutes (config.h network section); it bounds
+    # the whole retry schedule like it bounds the socket Construct loop
+    timeout_s = 60.0 * float(getattr(config, "time_out", 0) or 0)
+    initialize_with_retry(
+        f"{host}:{port}", num_machines, pid,
+        retries=int(getattr(config, "distributed_init_retries", 3) or 0),
+        backoff_s=float(getattr(config, "distributed_init_backoff", 2.0)
+                        or 0.0),
+        timeout_s=timeout_s)
     return True

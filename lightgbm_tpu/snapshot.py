@@ -1,0 +1,201 @@
+"""Crash-safe training snapshots: atomic, checksummed, bit-exact resume.
+
+Long boosting runs on shared accelerators die for reasons that have
+nothing to do with the model: preemption, OOM from a late-attached
+validation set, a flaky coordinator.  A snapshot taken every
+``snapshot_freq`` iterations into ``snapshot_dir`` makes those failures
+recoverable: ``engine.train`` (and therefore the CLI) auto-resumes from
+the newest *valid* snapshot and the resumed run is bit-identical to an
+uninterrupted one — train(N) == train(k) -> crash -> resume(N).
+
+What a snapshot holds (``GBDT.snapshot_state`` + subclass hooks):
+
+- the host trees themselves (pickled ``Tree`` objects, so the bin-space
+  split arrays survive exactly — no text round-trip),
+- the device score caches for train and every valid set (restoring them
+  directly is what makes resume bit-exact: replaying trees into a fresh
+  buffer would re-order float additions),
+- bagging PRNG key + the live row-weight mask and bag count,
+- the feature-fraction RNG state,
+- DART drop-RNG/tree-weight state and the GOSS sampling key,
+- engine-side eval history (``evals_result``) and best-iteration
+  bookkeeping,
+- the obs counter account (``lightgbm_tpu/obs``), restored so telemetry
+  stays cumulative across the crash.
+
+File format (``write_snapshot``): ``MAGIC | payload_len(8B LE) |
+sha256(payload) | payload(pickle)``, written to ``<path>.tmp`` +
+fsync + ``os.replace`` so a crash mid-write can never produce a file
+that parses.  ``read_snapshot`` returns None for anything that fails
+the magic/length/checksum/unpickle gauntlet, and
+``load_latest_snapshot`` walks the directory newest-first, skipping
+corrupt files with a warning — a torn or truncated newest snapshot
+falls back to the previous one.
+
+See docs/FAULT_TOLERANCE.md for the user-facing contract.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import os
+import pickle
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from .utils import log
+
+SNAPSHOT_MAGIC = b"LGBTSNAP\x01"
+SNAPSHOT_VERSION = 1
+
+_HEADER_LEN = len(SNAPSHOT_MAGIC) + 8 + 32
+_FILE_RE = re.compile(r"^snapshot_(\d+)\.bin$")
+
+
+# ---------------------------------------------------------------------------
+# file layer
+# ---------------------------------------------------------------------------
+
+def snapshot_path(directory: str, rounds_done: int) -> str:
+    return os.path.join(directory, f"snapshot_{int(rounds_done):010d}.bin")
+
+
+def _encode(state: Dict[str, Any]) -> bytes:
+    payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+    return (SNAPSHOT_MAGIC + len(payload).to_bytes(8, "little")
+            + hashlib.sha256(payload).digest() + payload)
+
+
+def write_snapshot(path: str, state: Dict[str, Any]) -> str:
+    """Atomically write ``state`` to ``path`` (tmp file + fsync +
+    ``os.replace``): a crash at any byte leaves either the previous file
+    or a ``.tmp`` that the checksummed reader ignores."""
+    blob = _encode(state)
+    directory = os.path.dirname(path)
+    if directory:
+        os.makedirs(directory, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(blob)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_snapshot(path: str) -> Optional[Dict[str, Any]]:
+    """Parse + validate one snapshot file.  Returns the state dict, or
+    None when the file is missing, truncated, corrupt (checksum), or not
+    a snapshot at all — the caller falls back to an older file."""
+    try:
+        with open(path, "rb") as fh:
+            blob = fh.read()
+    except OSError:
+        return None
+    if len(blob) < _HEADER_LEN or not blob.startswith(SNAPSHOT_MAGIC):
+        return None
+    n = int.from_bytes(blob[len(SNAPSHOT_MAGIC):len(SNAPSHOT_MAGIC) + 8],
+                       "little")
+    digest = blob[len(SNAPSHOT_MAGIC) + 8:_HEADER_LEN]
+    payload = blob[_HEADER_LEN:]
+    if len(payload) != n or hashlib.sha256(payload).digest() != digest:
+        return None
+    try:
+        state = pickle.loads(payload)
+    except Exception:
+        return None
+    if not isinstance(state, dict) or "booster" not in state:
+        return None
+    return state
+
+
+def list_snapshots(directory: str) -> List[Tuple[int, str]]:
+    """``(rounds_done, path)`` pairs present in ``directory``, newest
+    (highest round) first.  Existence only — validity is checked lazily
+    by ``load_latest_snapshot``."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        m = _FILE_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    out.sort(reverse=True)
+    return out
+
+
+def load_latest_snapshot(directory: str) \
+        -> Optional[Tuple[str, Dict[str, Any]]]:
+    """Newest snapshot in ``directory`` that passes validation, or None.
+    Corrupt/partial files (a torn newest write, bit rot) are skipped
+    with a warning so the run falls back to the previous good state."""
+    for rounds, path in list_snapshots(directory):
+        state = read_snapshot(path)
+        if state is not None:
+            return path, state
+        log.warning("snapshot %s is corrupt or truncated; falling back "
+                    "to an older snapshot", path)
+    return None
+
+
+def prune_snapshots(directory: str, keep: int) -> None:
+    """Delete all but the newest ``keep`` snapshot files (best-effort).
+    ``keep <= 0`` disables pruning."""
+    if keep <= 0:
+        return
+    for _, path in list_snapshots(directory)[keep:]:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# booster capture / restore glue
+# ---------------------------------------------------------------------------
+
+def capture_booster_state(booster, rounds_done: int,
+                          evals_result: Optional[dict] = None) \
+        -> Dict[str, Any]:
+    """Full resumable state of a training ``Booster`` after
+    ``rounds_done`` completed boosting rounds (flushes the pipelined
+    iteration first — ``GBDT.snapshot_state`` does that)."""
+    from . import obs
+    gb = booster._booster
+    return {
+        "version": SNAPSHOT_VERSION,
+        "rounds_done": int(rounds_done),
+        "booster": gb.snapshot_state(),
+        "evals_result": (copy.deepcopy(evals_result)
+                         if evals_result else None),
+        "best_iteration": int(booster.best_iteration),
+        "obs_counters": obs.snapshot()["counters"],
+    }
+
+
+def restore_booster_state(booster, state: Dict[str, Any]) -> int:
+    """Restore a ``capture_booster_state`` snapshot onto a freshly
+    constructed ``Booster`` (same params, same data).  Returns the
+    number of completed rounds.  The obs counter account is restored so
+    a fresh process continues the interrupted run's telemetry."""
+    from . import obs
+    booster._booster.restore_state(state["booster"])
+    booster.best_iteration = int(state.get("best_iteration", -1))
+    counters = state.get("obs_counters")
+    if counters:
+        obs.REGISTRY.restore({"counters": counters})
+    return int(state.get("rounds_done", 0))
+
+
+def save_snapshot(directory: str, booster, rounds_done: int,
+                  evals_result: Optional[dict] = None,
+                  keep: int = 0) -> str:
+    """Capture + atomically write one snapshot; prune old files when
+    ``keep > 0``.  Returns the written path."""
+    state = capture_booster_state(booster, rounds_done, evals_result)
+    path = write_snapshot(snapshot_path(directory, rounds_done), state)
+    prune_snapshots(directory, keep)
+    return path

@@ -1,0 +1,8 @@
+"""Test-support utilities shipped with the package (not test code):
+``lightgbm_tpu.testing.faults`` is the fault-injection harness used by
+``tests/test_fault_tolerance.py`` to prove each recovery path
+(docs/FAULT_TOLERANCE.md) end-to-end."""
+
+from . import faults  # noqa: F401
+
+__all__ = ["faults"]

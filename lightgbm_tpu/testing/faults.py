@@ -1,0 +1,155 @@
+"""Fault-injection harness: make the failures the fault-tolerance layer
+claims to survive actually happen, deterministically, in-process.
+
+Each injector is a context manager that patches exactly one seam and
+restores it on exit, so tests (tests/test_fault_tolerance.py, marker
+``faults``) can prove recovery paths end-to-end instead of unit-testing
+fragments:
+
+- ``poison_gradients``: non-finite gradients at one boosting iteration
+  (exercises ``nan_policy`` containment, docs/FAULT_TOLERANCE.md);
+- ``fail_distributed_init``: the next N ``jax.distributed.initialize``
+  attempts raise (exercises the multihost retry/backoff loop);
+- ``torn_snapshot_write``: a snapshot write crashes mid-file (exercises
+  the atomic tmp+``os.replace`` protocol and checksum fallback);
+- ``truncate_file`` / ``flip_byte``: corrupt a file on disk after the
+  fact (bit rot / torn storage on an already-written snapshot).
+
+None of these are test-only hacks around private invariants: they throw
+real exceptions through real call stacks, which is the point.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+
+class InjectedCrash(RuntimeError):
+    """Raised by injectors simulating a hard process death.  Distinct
+    type so tests can assert THIS crash surfaced, not some other bug."""
+
+
+@contextlib.contextmanager
+def poison_gradients(booster, at_iteration: int,
+                     value: float = float("nan"),
+                     times: int = 1) -> Iterator[object]:
+    """Make the objective emit ``value`` for every gradient, ``times``
+    times, starting at boosting iteration ``at_iteration`` (0-based,
+    absolute ``iter_`` index).  A transient fault by default (one
+    poisoned round: under ``nan_policy=skip_tree`` the retry of the
+    same iteration index then succeeds); pass a large ``times`` for a
+    persistently degenerate objective.
+
+    Accepts a ``basic.Booster`` or a raw ``models.gbdt.GBDT``.  The
+    injector wraps the instance's ``_gradients`` hook and forces the
+    per-stage path (``LGBT_NO_FUSED_STEP``) while active — the fused
+    step bakes the objective into one compiled program, so a host-side
+    wrapper could never fire inside it."""
+    gb = getattr(booster, "_booster", booster)
+    orig = gb._gradients
+    fired = [0]
+
+    def poisoned_gradients():
+        grad, hess = orig()
+        if gb.iter_ >= at_iteration and fired[0] < times:
+            fired[0] += 1
+            import jax.numpy as jnp
+            grad = jnp.full_like(grad, value)
+        return grad, hess
+
+    old_env = os.environ.get("LGBT_NO_FUSED_STEP")
+    os.environ["LGBT_NO_FUSED_STEP"] = "1"
+    gb._gradients = poisoned_gradients
+    try:
+        yield gb
+    finally:
+        gb.__dict__.pop("_gradients", None)
+        if old_env is None:
+            os.environ.pop("LGBT_NO_FUSED_STEP", None)
+        else:
+            os.environ["LGBT_NO_FUSED_STEP"] = old_env
+
+
+@contextlib.contextmanager
+def fail_distributed_init(times: int = 1,
+                          message: str = "injected coordinator connect "
+                          "failure") -> Iterator[dict]:
+    """Patch ``jax.distributed.initialize`` to raise ``RuntimeError``
+    for the first ``times`` calls; later calls succeed as recorded
+    no-ops (the harness cannot bring up a real coordinator inside one
+    test process).  Yields a stats dict: ``failed`` / ``succeeded``
+    call counts and the ``kwargs`` of every attempt."""
+    import jax
+
+    stats = {"failed": 0, "succeeded": 0, "kwargs": []}
+    orig = jax.distributed.initialize
+
+    def flaky_initialize(*args, **kwargs):
+        stats["kwargs"].append(kwargs)
+        if stats["failed"] < times:
+            stats["failed"] += 1
+            raise RuntimeError(message)
+        stats["succeeded"] += 1
+
+    jax.distributed.initialize = flaky_initialize
+    try:
+        yield stats
+    finally:
+        jax.distributed.initialize = orig
+
+
+@contextlib.contextmanager
+def torn_snapshot_write(after_bytes: int = 64) -> Iterator[dict]:
+    """Crash every ``lightgbm_tpu.snapshot.write_snapshot`` after
+    ``after_bytes`` bytes have reached the temp file — the moment a real
+    preemption would strike mid-checkpoint.  The atomicity contract
+    under test: no final snapshot file is ever produced or damaged, so
+    resume falls back to the previous good one.  Yields a stats dict
+    with the ``torn`` paths."""
+    from .. import snapshot as snapmod
+
+    stats = {"torn": []}
+    orig = snapmod.write_snapshot
+
+    def torn_write(path, state):
+        blob = snapmod._encode(state)
+        directory = os.path.dirname(path)
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+        with open(path + ".tmp", "wb") as fh:
+            fh.write(blob[:max(int(after_bytes), 0)])
+        stats["torn"].append(path)
+        raise InjectedCrash(
+            f"snapshot write to {path} torn after {after_bytes} bytes")
+
+    snapmod.write_snapshot = torn_write
+    try:
+        yield stats
+    finally:
+        snapmod.write_snapshot = orig
+
+
+def truncate_file(path: str, keep_bytes: Optional[int] = None) -> None:
+    """Truncate ``path`` in place (default: half its size) — an
+    already-committed snapshot damaged by torn storage.  The checksummed
+    reader must treat the result as absent."""
+    size = os.path.getsize(path)
+    keep = size // 2 if keep_bytes is None else max(int(keep_bytes), 0)
+    with open(path, "r+b") as fh:
+        fh.truncate(keep)
+
+
+def flip_byte(path: str, offset: int = -1) -> None:
+    """XOR one byte of ``path`` (default: the last byte — payload, past
+    every header field) to simulate silent bit rot under a still-valid
+    length."""
+    with open(path, "r+b") as fh:
+        fh.seek(0, os.SEEK_END)
+        size = fh.tell()
+        pos = offset if offset >= 0 else size + offset
+        fh.seek(pos)
+        b = fh.read(1)
+        fh.seek(pos)
+        fh.write(bytes([b[0] ^ 0xFF]))

@@ -1,0 +1,324 @@
+"""Fault tolerance end-to-end (docs/FAULT_TOLERANCE.md): crash-safe
+snapshot/resume bit-exactness (GBDT/DART/GOSS, bagging + feature RNG +
+eval history), corrupt-snapshot fallback, torn-write atomicity, NaN/Inf
+containment policies, and hardened multihost bring-up — each failure
+injected by ``lightgbm_tpu.testing.faults``, never simulated by poking
+internals the real failure would not touch."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import Booster, Dataset, LightGBMError, obs
+from lightgbm_tpu import train as lgb_train
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.snapshot import (list_snapshots, load_latest_snapshot,
+                                   read_snapshot, snapshot_path,
+                                   write_snapshot)
+from lightgbm_tpu.testing import faults
+
+pytestmark = pytest.mark.faults
+
+
+def _data(seed=7, n=200, f=5):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    logit = 1.3 * X[:, 0] - X[:, 1] + 0.6 * X[:, 2] * X[:, 3]
+    y = (logit + rng.normal(scale=0.4, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+# bagging + feature_fraction on purpose: resume must restore BOTH RNG
+# streams mid-sequence for bit-exactness
+BASE = {"objective": "binary", "metric": ["binary_logloss"],
+        "num_leaves": 7, "min_data_in_leaf": 5, "max_bin": 31,
+        "learning_rate": 0.2, "bagging_fraction": 0.7, "bagging_freq": 1,
+        "feature_fraction": 0.8}
+
+N_ROUNDS = 6
+CRASH_AT = 3        # iteration index whose after-callback dies
+SNAP_FREQ = 2       # so the newest snapshot at crash time holds 2 rounds
+
+
+class _Crash(RuntimeError):
+    pass
+
+
+def _crash_after(iteration):
+    def cb(env):
+        if env.iteration == iteration:
+            raise _Crash(f"injected crash at iteration {iteration}")
+    cb.order = 99
+    return cb
+
+
+def _train(params, num_rounds=N_ROUNDS, seed=7, callbacks=None):
+    X, y = _data(seed)
+    Xv, yv = _data(seed + 1)
+    ds = Dataset(X, label=y)
+    ev = {}
+    bst = lgb_train(dict(params), ds, num_boost_round=num_rounds,
+                    valid_sets=[ds.create_valid(Xv, yv)],
+                    valid_names=["v0"], evals_result=ev,
+                    verbose_eval=False, callbacks=callbacks)
+    return bst, ev
+
+
+def _crash_then_resume(params, tmp_path, num_rounds=N_ROUNDS,
+                       crash_at=CRASH_AT):
+    snap = {**params, "snapshot_dir": str(tmp_path),
+            "snapshot_freq": SNAP_FREQ}
+    with pytest.raises(_Crash):
+        _train(snap, num_rounds, callbacks=[_crash_after(crash_at)])
+    return _train(snap, num_rounds)
+
+
+def _assert_bit_identical(a, ev_a, b, ev_b):
+    assert a.model_to_string() == b.model_to_string()
+    Xq, _ = _data(seed=99)
+    assert np.array_equal(a.predict(Xq), b.predict(Xq))
+    assert ev_a == ev_b
+
+
+@pytest.mark.parametrize("extra", [
+    {},                                                       # plain gbdt
+    {"boosting_type": "dart", "drop_rate": 0.5,
+     "skip_drop": 0.25},                                      # dart state
+])
+def test_resume_bit_exact(tmp_path, extra):
+    params = {**BASE, **extra}
+    plain, ev_plain = _train(params)
+    resumed, ev_resumed = _crash_then_resume(params, tmp_path)
+    _assert_bit_identical(plain, ev_plain, resumed, ev_resumed)
+
+
+def test_resume_bit_exact_goss(tmp_path):
+    # high lr so the 1/lr warmup ends mid-run and the sampling key is
+    # live (and therefore snapshot-restored) across the crash boundary
+    params = {"objective": "binary", "metric": ["binary_logloss"],
+              "num_leaves": 7, "min_data_in_leaf": 5, "max_bin": 31,
+              "learning_rate": 0.5, "boosting_type": "goss",
+              "top_rate": 0.3, "other_rate": 0.2}
+    plain, ev_plain = _train(params)
+    resumed, ev_resumed = _crash_then_resume(params, tmp_path)
+    _assert_bit_identical(plain, ev_plain, resumed, ev_resumed)
+
+
+def test_corrupt_newest_snapshot_falls_back(tmp_path):
+    plain, ev_plain = _train(BASE)
+    snap = {**BASE, "snapshot_dir": str(tmp_path),
+            "snapshot_freq": SNAP_FREQ}
+    # crash during iteration 4: rounds 2 AND 4 are both on disk
+    with pytest.raises(_Crash):
+        _train(snap, callbacks=[_crash_after(4)])
+    # torn storage on the newest file: resume must fall back to round 2
+    # and STILL converge to the bit-identical model
+    rounds, newest = list_snapshots(str(tmp_path))[0]
+    assert rounds == 4
+    faults.truncate_file(newest)
+    path, state = load_latest_snapshot(str(tmp_path))
+    assert path.endswith(f"snapshot_{2:010d}.bin")
+    assert state["rounds_done"] == 2
+    resumed, ev_resumed = _train(snap)
+    _assert_bit_identical(plain, ev_plain, resumed, ev_resumed)
+
+
+def test_torn_write_never_damages_previous(tmp_path):
+    plain, ev_plain = _train(BASE)
+    snap = {**BASE, "snapshot_dir": str(tmp_path),
+            "snapshot_freq": SNAP_FREQ}
+    _train(snap, num_rounds=2)               # a good round-2 snapshot
+    good = read_snapshot(snapshot_path(str(tmp_path), 2))
+    assert good is not None
+    with faults.torn_snapshot_write(after_bytes=64):
+        with pytest.raises(faults.InjectedCrash):
+            _train(snap)                     # resumes, dies at round 4
+    # the torn write left no committed file and the previous snapshot
+    # is byte-for-byte intact
+    assert [r for r, _ in list_snapshots(str(tmp_path))] == [2]
+    path, state = load_latest_snapshot(str(tmp_path))
+    assert state["rounds_done"] == 2
+    resumed, ev_resumed = _train(snap)
+    _assert_bit_identical(plain, ev_plain, resumed, ev_resumed)
+
+
+def test_snapshot_cli_string_params_and_noop_resume(tmp_path):
+    # CLI-style params arrive as strings; a re-run whose snapshot already
+    # holds num_boost_round rounds trains nothing and returns the model
+    snap = {**BASE, "snapshot_dir": str(tmp_path), "snapshot_freq": "2"}
+    bst, _ = _train(snap, num_rounds=4)
+    assert [r for r, _ in list_snapshots(str(tmp_path))] == [4, 2]
+    bst2, _ = _train(snap, num_rounds=4)
+    assert bst2.num_trees() == bst.num_trees()
+    assert bst2.model_to_string() == bst.model_to_string()
+
+
+def test_snapshot_file_roundtrip_and_corruption(tmp_path):
+    state = {"booster": {"x": np.arange(5)}, "rounds_done": 3}
+    path = snapshot_path(str(tmp_path), 3)
+    write_snapshot(path, state)
+    back = read_snapshot(path)
+    assert np.array_equal(back["booster"]["x"], np.arange(5))
+    faults.flip_byte(path)                   # silent bit rot
+    assert read_snapshot(path) is None
+    write_snapshot(path, state)
+    faults.truncate_file(path)               # torn tail
+    assert read_snapshot(path) is None
+    junk = tmp_path / f"snapshot_{1:010d}.bin"
+    junk.write_bytes(b"not a snapshot")      # wrong magic
+    assert read_snapshot(str(junk)) is None
+    assert load_latest_snapshot(str(tmp_path)) is None
+
+
+def test_snapshot_config_mismatch_refuses(tmp_path):
+    snap = {**BASE, "snapshot_dir": str(tmp_path), "snapshot_freq": 2}
+    _train(snap, num_rounds=2)
+    with pytest.raises(LightGBMError) as ei:
+        _train({**snap, "num_leaves": 15}, num_rounds=4)
+    assert "mismatch" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# NaN/Inf containment
+# ---------------------------------------------------------------------------
+
+def test_nan_fail_fast_names_iteration_and_objective():
+    X, y = _data()
+    ds = Dataset(X, label=y)
+    calls = {"n": 0}
+
+    def bad_fobj(preds, dset):
+        calls["n"] += 1
+        grad = preds - np.asarray(dset.get_label())
+        if calls["n"] == 3:
+            grad = np.full_like(grad, np.nan)
+        return grad, np.ones_like(grad)
+
+    with pytest.raises(LightGBMError) as ei:
+        lgb_train({"objective": "binary", "num_leaves": 7,
+                   "min_data_in_leaf": 5, "max_bin": 31,
+                   "nan_policy": "fail_fast"},
+                  ds, num_boost_round=6, fobj=bad_fobj,
+                  verbose_eval=False)
+    msg = str(ei.value)
+    assert "boosting iteration 2" in msg
+    assert "gradients/hessians" in msg
+
+
+def test_nan_skip_tree_completes_and_records(tmp_path):
+    from lightgbm_tpu.obs import EventRecorder, read_events
+    X, y = _data()
+    ds = Dataset(X, label=y)
+    bst = Booster(params={**BASE, "nan_policy": "skip_tree"},
+                  train_set=ds)
+    events = tmp_path / "events.jsonl"
+    rec = EventRecorder(str(events))
+    bst.set_event_recorder(rec)
+    dropped0 = obs.get_counter("nan_iterations_dropped")
+    with faults.poison_gradients(bst, at_iteration=2):
+        for _ in range(6):
+            bst.update()
+    n_trees = bst.num_trees()                # flushes the pipeline
+    rec.close()
+    # 6 update calls, one poisoned round dropped, its index re-trained
+    assert n_trees == 5
+    assert bst.current_iteration() == 5
+    assert obs.get_counter("nan_iterations_dropped") == dropped0 + 1
+    recs = read_events(str(events))
+    hit = [e for e in recs if e.get("nan_poisoned")]
+    assert hit and hit[0]["iter"] == 2
+    assert hit[0]["nan_policy"] == "skip_tree"
+    assert hit[0]["nan_poisoned"] == "gradients/hessians"
+    assert np.isfinite(bst.predict(X)).all()
+
+
+def test_degenerate_objective_all_rounds_skipped():
+    # persistent poison: every remaining round drops, training still
+    # terminates with the pre-fault model intact (graceful degradation)
+    X, y = _data()
+    ds = Dataset(X, label=y)
+    bst = Booster(params={**BASE, "nan_policy": "skip_tree"},
+                  train_set=ds)
+    with faults.poison_gradients(bst, at_iteration=2, times=10 ** 6):
+        for _ in range(5):
+            bst.update()
+    assert bst.num_trees() == 2
+    assert np.isfinite(bst.predict(X)).all()
+
+
+# ---------------------------------------------------------------------------
+# multihost bring-up hardening
+# ---------------------------------------------------------------------------
+
+def _mlist(tmp_path):
+    p = tmp_path / "mlist.txt"
+    p.write_text("127.0.0.1 12400\n10.255.255.1 12401\n")
+    return str(p)
+
+
+def _dist_cfg(tmp_path, **over):
+    return Config({"task": "train", "objective": "binary",
+                   "num_machines": 2, "tree_learner": "data",
+                   "machine_list_file": _mlist(tmp_path),
+                   "distributed_init_backoff": 0.0, **over})
+
+
+def test_distributed_init_retries_until_success(tmp_path):
+    from lightgbm_tpu.parallel.multihost import maybe_initialize_distributed
+    cfg = _dist_cfg(tmp_path, distributed_init_retries=3)
+    with faults.fail_distributed_init(times=2) as stats:
+        assert maybe_initialize_distributed(cfg) is True
+    assert stats["failed"] == 2
+    assert stats["succeeded"] == 1
+    assert stats["kwargs"][-1] == {
+        "coordinator_address": "127.0.0.1:12400",
+        "num_processes": 2, "process_id": 0}
+
+
+def test_distributed_init_exhaustion_diagnostic(tmp_path):
+    from lightgbm_tpu.parallel.multihost import maybe_initialize_distributed
+    cfg = _dist_cfg(tmp_path, distributed_init_retries=1)
+    with faults.fail_distributed_init(times=10):
+        with pytest.raises(LightGBMError) as ei:
+            maybe_initialize_distributed(cfg)
+    msg = str(ei.value)
+    assert "127.0.0.1:12400" in msg
+    assert "2 attempt(s)" in msg
+    assert "injected coordinator connect failure" in msg
+
+
+def test_process_id_env_out_of_range(monkeypatch):
+    from lightgbm_tpu.parallel.multihost import find_process_id
+    machines = [("a", 1), ("b", 2), ("c", 3)]
+    monkeypatch.setenv("LIGHTGBM_TPU_PROCESS_ID", "7")
+    with pytest.raises(LightGBMError) as ei:
+        find_process_id(machines)
+    assert "out of range" in str(ei.value)
+    assert "0..2" in str(ei.value)
+    monkeypatch.setenv("LIGHTGBM_TPU_PROCESS_ID", "-1")
+    with pytest.raises(LightGBMError):
+        find_process_id(machines)
+
+
+# ---------------------------------------------------------------------------
+# late-attached validation set memory budget
+# ---------------------------------------------------------------------------
+
+def test_valid_set_attachment_respects_memory_budget(monkeypatch):
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.models.gbdt import GBDT, estimate_train_memory
+    X, y = _data(n=400)
+    Xv, yv = _data(seed=9, n=400)
+    ds = BinnedDataset.from_matrix(X, y, max_bin=31, min_data_in_leaf=5)
+    cfg = Config({"objective": "binary", "num_leaves": 7, "max_bin": 31,
+                  "min_data_in_leaf": 5})
+    est = estimate_train_memory(ds.num_data, ds.num_features,
+                                cfg.num_leaves, cfg.max_bin, 1,
+                                bin_itemsize=ds.bins.dtype.itemsize)
+    # training alone fits; training + the valid set does not
+    monkeypatch.setenv("LGBT_DEVICE_MEMORY_BYTES", str(est["total"] + 512))
+    gb = GBDT(cfg, ds)
+    with pytest.raises(LightGBMError) as ei:
+        gb.add_valid_dataset(ds.create_valid(Xv, yv))
+    msg = str(ei.value)
+    assert "validation set" in msg
+    assert "budget" in msg
