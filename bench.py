@@ -50,6 +50,92 @@ def make_higgs_like(num_data: int, num_features: int = 28, seed: int = 42):
     return X.astype(np.float64), y
 
 
+def predict_main() -> None:
+    """--mode predict: serving throughput/latency benchmark.
+
+    Trains a small forest at the reference operating point (63 leaves,
+    255 bins, binary), freezes it into a ``serve.CompiledForest``, warms
+    every bucket, then measures the fused device-binned predict path
+    (the server hot path) per batch size.  One BENCH-style JSON line:
+    rows/sec at the largest batch as the headline, per-batch-size
+    rows/sec + p50/p99 call latency in ``batches``."""
+    rows = int(os.environ.get("BENCH_PREDICT_ROWS", 1_000_000))
+    train_rows = int(os.environ.get("BENCH_PREDICT_TRAIN_ROWS", 100_000))
+    trees = int(os.environ.get("BENCH_PREDICT_TREES", 40))
+    calls = int(os.environ.get("BENCH_PREDICT_CALLS", 30))
+    sizes = [int(s) for s in os.environ.get(
+        "BENCH_PREDICT_BATCHES", "256,2048,16384,65536").split(",")]
+    sizes = [s for s in sizes if s <= rows] or [rows]
+
+    import jax
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                       "/tmp/lightgbm_tpu_jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.serve.forest import CompiledForest
+    from lightgbm_tpu import obs
+
+    X, y = make_higgs_like(rows)
+    cfg = Config({"objective": "binary", "metric": "auc",
+                  "num_leaves": 63, "max_bin": 255, "learning_rate": 0.1,
+                  "min_data_in_leaf": 50, "num_iterations": trees})
+    t0 = time.time()
+    ds = BinnedDataset.from_matrix(X[:train_rows], y[:train_rows],
+                                   max_bin=255, min_data_in_leaf=50)
+    booster = GBDT(cfg, ds)
+    for _ in range(trees):
+        booster.train_one_iter()
+    t_train = time.time() - t0
+
+    t0 = time.time()
+    from lightgbm_tpu.serve.batcher import default_ladder
+    # ladder capped at the largest measured size (default_ladder always
+    # includes its `hi` endpoint), so warmup() covers every bucket any
+    # measured batch can route to — no hidden compile in the timings
+    forest = CompiledForest.from_booster(
+        booster, buckets=default_ladder(16, max(sizes)))
+    forest.warmup()
+    t_warm = time.time() - t0
+
+    X32 = X.astype(np.float32)
+    batches = {}
+    for size in sizes:
+        # touch distinct row windows so cache effects resemble traffic
+        lat = []
+        done = 0
+        for i in range(calls):
+            off = (i * size) % max(rows - size + 1, 1)
+            t0 = time.time()
+            raw, out = forest.batched_fn()(X32[off:off + size])
+            np.asarray(out)                      # block until materialized
+            lat.append((time.time() - t0) * 1000.0)
+            done += size
+        total_s = sum(lat) / 1000.0
+        batches[str(size)] = {
+            "rows_per_sec": round(done / total_s, 1),
+            "p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat, 99)), 3),
+        }
+    top = batches[str(max(sizes))]
+    print(json.dumps({
+        "metric": f"serve_rows_per_sec_higgslike_{trees}trees_"
+                  "63leaves_255bins_binary",
+        "value": top["rows_per_sec"],
+        "unit": "rows/sec",
+        "vs_baseline": None,
+        "batches": batches,
+    }))
+    c = obs.snapshot()["counters"]
+    print(f"# device={jax.devices()[0].platform} train_s={t_train:.1f} "
+          f"warmup_s={t_warm:.1f} calls_per_size={calls} "
+          f"serve_compiles={c.get('serve_forest_compiles', 0)} "
+          f"post_warmup_compiles_expected=0", file=sys.stderr)
+
+
 def main() -> None:
     num_data = int(os.environ.get("BENCH_ROWS", 1_000_000))
     num_warmup = int(os.environ.get("BENCH_WARMUP", 5))
@@ -139,5 +225,18 @@ def main() -> None:
           file=sys.stderr)
 
 
+def _parse_mode(argv) -> str:
+    mode = "train"
+    for i, tok in enumerate(argv):
+        if tok == "--mode" and i + 1 < len(argv):
+            mode = argv[i + 1]
+        elif tok.startswith("--mode="):
+            mode = tok.split("=", 1)[1]
+    return mode
+
+
 if __name__ == "__main__":
-    main()
+    if _parse_mode(sys.argv[1:]) == "predict":
+        predict_main()
+    else:
+        main()

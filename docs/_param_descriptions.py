@@ -15,6 +15,15 @@ DESC = {
     "serial_grow": "ordered | cached — serial-learner strategy (leaf-ordered "
                    "physical layout vs original-order cached learner; "
                    "TPU-specific extension)",
+    "serve_host": "task=serve: HTTP bind address (docs/SERVING.md)",
+    "serve_port": "task=serve: HTTP port",
+    "serve_max_batch": "task=serve: row cap per coalesced device batch "
+                       "(micro-batcher, serve/batcher.py)",
+    "serve_max_delay_ms": "task=serve: micro-batch coalescing deadline "
+                          "measured from the oldest queued request",
+    "predict_buckets": "batch bucket ladder for the compiled-forest "
+                       "predict paths (comma-separated sizes; empty = "
+                       "powers of two 16..65536; docs/SERVING.md)",
     "events_file": "per-iteration JSONL telemetry stream path "
                    "(docs/OBSERVABILITY.md; --events-file on the CLI)",
     "trace_dir": "device trace output dir; LIGHTGBM_TPU_TRACE_DIR env "

@@ -15,6 +15,7 @@ from .basic import Booster, Dataset  # noqa: F401
 from .callback import (early_stopping, log_telemetry,  # noqa: F401
                        print_evaluation, record_evaluation, reset_parameter)
 from . import obs  # noqa: F401
+from . import serve  # noqa: F401
 from .engine import CVBooster, cv, train  # noqa: F401
 from .sklearn import (LGBMClassifier, LGBMModel,  # noqa: F401
                       LGBMRanker, LGBMRegressor)
@@ -30,5 +31,5 @@ __all__ = ["Dataset", "Booster", "Config",
            "train", "cv", "CVBooster",
            "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
            "print_evaluation", "record_evaluation", "reset_parameter",
-           "early_stopping", "log_telemetry", "obs",
+           "early_stopping", "log_telemetry", "obs", "serve",
            "LightGBMError"] + _PLOTTING

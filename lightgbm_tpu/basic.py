@@ -407,6 +407,13 @@ class Dataset:
 class Booster:
     """Booster in LightGBM-TPU (reference Booster, basic.py:1155)."""
 
+    # compiled-forest inference artifacts (lightgbm_tpu/serve/):
+    # _compiled is the explicit ``compile()`` snapshot, _auto_forest the
+    # lazily built large-array fast path.  Class-level defaults so
+    # pickled/old instances behave.
+    _compiled = None
+    _auto_forest = None
+
     def __init__(self, params=None, train_set=None, model_file=None,
                  silent=False):
         params = dict(params or {})
@@ -561,6 +568,70 @@ class Booster:
     # -- prediction ------------------------------------------------------
     _PREDICT_CHUNK_ROWS = 1 << 16
 
+    def compile(self, num_iteration=-1, buckets=None, warmup=False):
+        """Freeze the current model into a ``serve.CompiledForest`` and
+        make it this booster's predict fast path for ALL array sizes
+        (without an explicit compile, only large arrays of trained
+        boosters route through the artifact; loaded model files keep the
+        f64 host walk).  Returns the forest, which is also the artifact
+        ``python -m lightgbm_tpu serve`` and the micro-batching server
+        consume — see docs/SERVING.md.
+
+        ``buckets`` overrides the batch bucket ladder (defaulting to the
+        ``predict_buckets`` param, then powers of two); ``warmup=True``
+        pre-compiles every bucket so no later predict hits XLA."""
+        from .serve.forest import CompiledForest
+        cf = CompiledForest.from_booster(self, num_iteration=num_iteration,
+                                         buckets=buckets
+                                         or self._config_buckets())
+        if warmup:
+            cf.warmup()
+        self._compiled = (self._model_key(), int(num_iteration), cf)
+        return cf
+
+    def _model_key(self):
+        """Staleness key for cached CompiledForests: the model count AND
+        the last tree's identity, so rollback_one_iter + retraining to
+        the same count still invalidates the artifact.  Holding the Tree
+        object keeps the identity stable while the cache lives."""
+        models = self._booster.models
+        return (len(models), models[-1] if models else None)
+
+    def _compiled_for(self, num_iteration, n_rows):
+        """The CompiledForest to serve this predict, or None for the
+        legacy paths.  An explicit ``compile()`` snapshot wins while it
+        matches the current model; otherwise trained boosters lazily
+        freeze one for large arrays (the old per-shape device path's
+        threshold), so chunked file predict and varying batch sizes
+        share one bucketed compile cache."""
+        b = self._booster
+        n_models = len(b.models)
+        if num_iteration > 0:
+            n_models = min(n_models, int(num_iteration) * b.num_class)
+        if self._compiled is not None:
+            mkey, ni, cf = self._compiled
+            if mkey == self._model_key() and ni == int(num_iteration):
+                return cf
+        if (n_rows >= b._DEVICE_PREDICT_MIN_ROWS and n_models > 0
+                and getattr(b, "train_set", None) is not None):
+            key = (self._model_key(), int(num_iteration))
+            if self._auto_forest is not None \
+                    and self._auto_forest[0] == key:
+                return self._auto_forest[1]
+            from .serve.forest import CompiledForest
+            cf = CompiledForest.from_booster(
+                self, num_iteration=num_iteration,
+                buckets=self._config_buckets())
+            self._auto_forest = (key, cf)
+            return cf
+        return None
+
+    def _config_buckets(self):
+        """The ``predict_buckets`` param as a ladder override (None =
+        the default power-of-two ladder)."""
+        buckets = list(getattr(self.config, "predict_buckets", []) or [])
+        return buckets or None
+
     def predict(self, data, num_iteration=-1, raw_score=False,
                 pred_leaf=False, data_has_header=False, is_reshape=True):
         """Batch prediction (reference predict, basic.py:1560).
@@ -571,17 +642,9 @@ class Booster:
         (src/application/predictor.hpp:81-129)."""
         b = self._booster
         if isinstance(data, str):
-            from .io.parser import parse_file_chunks
-            parts = []
-            for _, X in parse_file_chunks(
-                    data, has_header=data_has_header,
-                    label_idx=b.label_idx,
-                    num_features=b.max_feature_idx + 1,
-                    chunk_rows=self._PREDICT_CHUNK_ROWS):
-                if X.size == 0:
-                    continue
-                parts.append(self._predict_array(X, num_iteration,
-                                                 raw_score, pred_leaf))
+            parts = list(self.predict_chunks(
+                data, num_iteration=num_iteration, raw_score=raw_score,
+                pred_leaf=pred_leaf, data_has_header=data_has_header))
             if not parts:
                 # empty file: predict an empty matrix so the result keeps
                 # the normal shape contract ((0, trees) for pred_leaf,
@@ -602,10 +665,41 @@ class Booster:
             return out.T                      # [n, num_class]
         return out.reshape(-1)
 
+    def predict_chunks(self, data_path, num_iteration=-1, raw_score=False,
+                       pred_leaf=False, data_has_header=False):
+        """Stream a data file's predictions chunk by chunk: yields one
+        prediction array per parsed chunk of ``_PREDICT_CHUNK_ROWS``
+        rows ([num_class, n] — or [n, num_trees] for ``pred_leaf``), so
+        callers can write results with O(chunk) peak memory.  The single
+        source of the file-predict loop: ``predict`` concatenates these,
+        the CLI's ``task=predict`` streams them to ``output_result``."""
+        b = self._booster
+        from .io.parser import parse_file_chunks
+        for _, X in parse_file_chunks(
+                data_path, has_header=data_has_header,
+                label_idx=b.label_idx,
+                num_features=b.max_feature_idx + 1,
+                chunk_rows=self._PREDICT_CHUNK_ROWS):
+            if X.size == 0:
+                continue
+            yield self._predict_array(X, num_iteration, raw_score,
+                                      pred_leaf)
+
     def _predict_array(self, X, num_iteration, raw_score, pred_leaf):
         b = self._booster
         if pred_leaf:
             return b.predict_leaf_index(X, num_iteration)
+        cf = self._compiled_for(num_iteration, X.shape[0])
+        if cf is not None:
+            # compiled-forest fast path: host-exact cut-table binning +
+            # the stacked SoA walk, bucketed so mixed batch sizes reuse
+            # compiles (serve/forest.py)
+            raw = cf.raw_scores(X)
+            if raw_score:
+                return raw
+            obj = getattr(b, "objective", None)
+            return raw if obj is None else np.asarray(
+                obj.convert_output(raw))
         out = (b.predict_raw(X, num_iteration) if raw_score
                else b.predict(X, num_iteration))
         return np.asarray(out)
@@ -714,6 +808,10 @@ class Booster:
         state.pop("_booster", None)
         state.pop("_train_set", None)
         state.pop("_valid_sets", None)
+        # compiled forests hold device buffers and jit caches; rebuild
+        # on demand after unpickling instead of serializing them
+        state.pop("_compiled", None)
+        state.pop("_auto_forest", None)
         state["_model_str"] = self.model_to_string()
         return state
 

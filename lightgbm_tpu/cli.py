@@ -80,30 +80,66 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
     log.info("Finished training. Model saved to %s", out)
 
 
+def _write_prediction_rows(fh, part: np.ndarray, pred_leaf: bool) -> None:
+    """One chunk of predictions -> output_result lines, matching the
+    historical full-matrix formatting: one ``%g`` per line for a single
+    class, tab-joined rows for multiclass / leaf indices."""
+    if pred_leaf:
+        rows = part                       # [n, num_trees]
+    elif part.shape[0] == 1:
+        for v in part[0]:
+            fh.write(f"{v:g}\n")
+        return
+    else:
+        rows = part.T                     # [n, num_class]
+    for row in rows:
+        fh.write("\t".join(f"{v:g}" for v in row) + "\n")
+
+
 def run_predict(config: Config, params: Dict[str, str]) -> None:
-    """Application::Predict (application.cpp:243-257) via Predictor."""
+    """Application::Predict (application.cpp:243-257) via Predictor.
+
+    Results STREAM to ``output_result``: each parsed chunk's predictions
+    are written as they complete instead of accumulating the whole
+    result matrix, so file-to-file scoring peaks at O(chunk) memory.
+    The chunked array predicts ride the shape-bucketed compiled-forest
+    cache (serve/batcher.py), so the mixed chunk sizes a file produces
+    (full chunks + remainder) do not each pay an XLA compile."""
     if not config.input_model:
         log.fatal("No model file specified (input_model=...)")
     if not config.data:
         log.fatal("No prediction data specified (data=...)")
     booster = Booster(params=dict(params), model_file=config.input_model)
     start = time.time()
-    out = booster.predict(config.data,
-                          num_iteration=config.num_iteration_predict,
-                          raw_score=config.is_predict_raw_score,
-                          pred_leaf=config.is_predict_leaf_index,
-                          data_has_header=config.has_header)
     result_path = config.output_result or "LightGBM_predict_result.txt"
-    arr = np.asarray(out)
+    pred_leaf = config.is_predict_leaf_index
+    if not pred_leaf and booster.num_trees() > 0:
+        # model-file boosters have no train_set, so the large-array
+        # auto-freeze never fires for them; compile explicitly (the cut
+        # tables come from the forest itself) so every chunk rides the
+        # bucketed device program instead of the per-tree host walk
+        booster.compile(num_iteration=config.num_iteration_predict)
+    n_rows = 0
     with open(result_path, "w") as fh:
-        if arr.ndim == 1:
-            for v in arr:
-                fh.write(f"{v:g}\n")
-        else:
-            for row in arr:
-                fh.write("\t".join(f"{v:g}" for v in row) + "\n")
-    log.info("%f seconds elapsed, finished prediction", time.time() - start)
+        for part in booster.predict_chunks(
+                config.data, num_iteration=config.num_iteration_predict,
+                raw_score=config.is_predict_raw_score,
+                pred_leaf=pred_leaf, data_has_header=config.has_header):
+            part = np.asarray(part)
+            _write_prediction_rows(fh, part, pred_leaf)
+            n_rows += part.shape[0] if pred_leaf else part.shape[-1]
+    log.info("%f seconds elapsed, finished prediction of %d rows",
+             time.time() - start, n_rows)
     log.info("Finished prediction. Results saved to %s", result_path)
+
+
+def run_serve(config: Config, params: Dict[str, str]) -> None:
+    """task=serve: freeze ``input_model`` into a CompiledForest, warm
+    every bucket, and serve micro-batched predictions over HTTP until
+    SIGINT/SIGTERM (lightgbm_tpu/serve/, docs/SERVING.md)."""
+    from .serve.server import serve_from_config
+    server = serve_from_config(config, params)
+    server.serve_forever()
 
 
 def main(argv=None) -> int:
@@ -112,14 +148,21 @@ def main(argv=None) -> int:
         print("usage: python -m lightgbm_tpu config=<conf> [key=value ...] "
               "[--events-file=<jsonl>] [--trace-dir=<dir>] "
               "[snapshot_dir=<dir> snapshot_freq=<K>] "
-              "[nan_policy=fail_fast|skip_tree]")
+              "[nan_policy=fail_fast|skip_tree]\n"
+              "       python -m lightgbm_tpu serve input_model=<model> "
+              "[serve_port=<p> serve_max_batch=<n> serve_max_delay_ms=<ms>]")
         return 1
+    # subcommand sugar: ``python -m lightgbm_tpu serve ...`` is the
+    # reference-style ``task=serve`` (docs/SERVING.md)
+    argv = ["task=serve" if tok == "serve" else tok for tok in argv]
     params = parse_cli_args(argv)
     config = Config(params)
     if config.task == "train":
         run_train(config, params)
     elif config.task in ("predict", "prediction", "test"):
         run_predict(config, params)
+    elif config.task == "serve":
+        run_serve(config, params)
     else:
         log.fatal("Unknown task type %s", config.task)
     return 0

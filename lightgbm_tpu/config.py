@@ -198,6 +198,12 @@ _DEFAULTS: Dict[str, Any] = {
     "nan_policy": "none",      # none | fail_fast | skip_tree
     "distributed_init_retries": 3,    # coordinator-connect retries
     "distributed_init_backoff": 2.0,  # first retry delay, seconds (x2 each)
+    # serving (lightgbm_tpu/serve/; docs/SERVING.md)
+    "serve_host": "127.0.0.1",  # bind address for task=serve
+    "serve_port": 8080,         # HTTP port for task=serve
+    "serve_max_batch": 8192,    # micro-batcher row cap per device batch
+    "serve_max_delay_ms": 5.0,  # micro-batch coalescing deadline
+    "predict_buckets": [],      # batch bucket ladder ([] = powers of two)
     # observability (lightgbm_tpu/obs/; docs/OBSERVABILITY.md)
     "events_file": "",         # per-iteration JSONL event stream path
     "trace_dir": "",           # device trace dir (LIGHTGBM_TPU_TRACE_DIR wins)
@@ -208,7 +214,8 @@ _DEFAULTS: Dict[str, Any] = {
 _BOOL_KEYS = {k for k, v in _DEFAULTS.items() if isinstance(v, bool)}
 _INT_KEYS = {k for k, v in _DEFAULTS.items() if isinstance(v, int) and not isinstance(v, bool)}
 _FLOAT_KEYS = {k for k, v in _DEFAULTS.items() if isinstance(v, float)}
-_LIST_KEYS = {"metric", "valid_data", "label_gain", "ndcg_eval_at"}
+_LIST_KEYS = {"metric", "valid_data", "label_gain", "ndcg_eval_at",
+              "predict_buckets"}
 
 _OBJECTIVE_ALIASES = {
     "regression": "regression",
@@ -314,7 +321,7 @@ class Config:
                 return out
             if key in ("label_gain",):
                 return _coerce_list(value, float)
-            if key in ("ndcg_eval_at",):
+            if key in ("ndcg_eval_at", "predict_buckets"):
                 return _coerce_list(value, int)
             return _coerce_list(value, str)
         if key in _BOOL_KEYS:
@@ -342,6 +349,12 @@ class Config:
                 "(expected none, fail_fast, or skip_tree)")
         if v["snapshot_freq"] < 0:
             raise ValueError("snapshot_freq must be >= 0")
+        if v["serve_max_batch"] <= 0:
+            raise ValueError("serve_max_batch must be > 0")
+        if v["serve_max_delay_ms"] < 0:
+            raise ValueError("serve_max_delay_ms must be >= 0")
+        if any(b <= 0 for b in v["predict_buckets"]):
+            raise ValueError("predict_buckets must be positive sizes")
         # num_machines here means mesh devices; 1 device => normalize back to
         # serial like the reference (config.cpp:161-172).
         if v["num_machines"] <= 1:

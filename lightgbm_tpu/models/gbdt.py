@@ -1198,7 +1198,21 @@ class GBDT:
             else:
                 bins_np[inner] = ts.mappers[inner].value_to_bin(
                     np.where(isnan, np.inf, col))
-        bins = jnp.asarray(bins_np)
+        # Shape-bucketed dispatch (serve/batcher.py): the forest jit
+        # specializes on N, so pad rows up the bucket ladder instead of
+        # compiling a fresh program for every batch size the caller
+        # happens to send (chunked file predict alone produces two).
+        # The padded bin matrix transfers to device ONCE per chunk and
+        # is shared by every class's tree stack.
+        from ..serve.batcher import BucketLadder
+        ladder = BucketLadder(
+            list(getattr(self.config, "predict_buckets", []) or []) or None)
+        counting = _counting_forest_jit()
+        dev_chunks = []
+        for off, m, bucket in ladder.chunks(n):
+            bpad = np.zeros((bins_np.shape[0], bucket), np.int32)
+            bpad[:, :m] = bins_np[:, off:off + m]
+            dev_chunks.append((off, m, bucket, jnp.asarray(bpad)))
         # continued training may hold trees larger than grow_params allows
         L = max(max(t.num_leaves for t in self.models[:n_models]), 2)
         out = np.zeros((self.num_class, n), np.float64)
@@ -1227,11 +1241,11 @@ class GBDT:
                 lc[t, :k] = tree.left_child
                 rc[t, :k] = tree.right_child
                 lv[t, :tree.num_leaves] = tree.leaf_value
-            val = predict_binned_forest(
-                jnp.asarray(sf), jnp.asarray(sb), jnp.asarray(ic),
-                jnp.asarray(lc), jnp.asarray(rc), jnp.asarray(lv),
-                bins, L)
-            out[cls] = np.asarray(val, np.float64)
+            args = (jnp.asarray(sf), jnp.asarray(sb), jnp.asarray(ic),
+                    jnp.asarray(lc), jnp.asarray(rc), jnp.asarray(lv))
+            for off, m, bucket, bdev in dev_chunks:
+                val = counting(bucket, *args, bdev, max_steps=L)
+                out[cls, off:off + m] = np.asarray(val, np.float64)[:m]
         return out
 
     def predict(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
@@ -1328,6 +1342,22 @@ class GBDT:
 
     def num_trees(self) -> int:
         return len(self.models)
+
+
+_COUNTING_FOREST_JIT = None
+
+
+def _counting_forest_jit():
+    """Process-wide compile-counting wrapper around the shared
+    ``predict_binned_forest`` jit.  A single instance, so the shape-key
+    fallback (jax builds without ``_cache_size``) accumulates across
+    calls instead of recounting warm hits as compiles."""
+    global _COUNTING_FOREST_JIT
+    if _COUNTING_FOREST_JIT is None:
+        from ..serve.batcher import CountingJit
+        _COUNTING_FOREST_JIT = CountingJit(predict_binned_forest,
+                                           "predict_forest")
+    return _COUNTING_FOREST_JIT
 
 
 def _mappers_aligned(a: BinnedDataset, b: BinnedDataset) -> bool:

@@ -29,20 +29,31 @@ HOST_PHASES = frozenset({
     "GBDT::valid_score",
     "GBDT::host_tree",
     "GBDT::metric",
+    # serving subsystem (lightgbm_tpu/serve/, docs/SERVING.md)
+    "Serve::batch",       # micro-batch assembly + device dispatch
+    "Predict::forest",    # one CompiledForest bucket call
 })
 
 DEVICE_PHASES = frozenset({
     "hist",
     "find_split",
     "split",
+    # CompiledForest fused inference program (serve/forest.py)
+    "bin_lookup",
+    "forest_walk",
+    "transform",
 })
 
 DEVICE_PARENT = {
     "hist": "GBDT::tree",
     "find_split": "GBDT::tree",
     "split": "GBDT::tree",
+    "bin_lookup": "Predict::forest",
+    "forest_walk": "Predict::forest",
+    "transform": "Predict::forest",
 }
 
 JITTED_HOST_PHASES = frozenset({
     "GBDT::tree",
+    "Predict::forest",
 })

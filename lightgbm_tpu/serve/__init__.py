@@ -1,0 +1,18 @@
+"""Compiled-forest inference subsystem (docs/SERVING.md).
+
+``forest``  — freeze a trained/loaded booster into an immutable
+              :class:`CompiledForest`: SoA tree stacks, forest-derived
+              cut tables, one fused bin-lookup -> walk -> transform jit.
+``batcher`` — shape-bucketed compile cache (:class:`BucketLadder`,
+              ``warmup()`` pre-compiles every bucket) + the
+              :class:`MicroBatcher` that coalesces concurrent requests
+              into device batches under a latency deadline.
+``server``  — stdlib HTTP front end (``python -m lightgbm_tpu serve``).
+"""
+
+from .batcher import BucketLadder, MicroBatcher, default_ladder  # noqa: F401
+from .forest import CompiledForest  # noqa: F401
+from .server import PredictServer, serve_from_config  # noqa: F401
+
+__all__ = ["CompiledForest", "BucketLadder", "MicroBatcher",
+           "default_ladder", "PredictServer", "serve_from_config"]

@@ -1,0 +1,301 @@
+"""Shape-bucketed compile cache + request micro-batcher.
+
+Every distinct batch shape fed to a jit is a fresh XLA compile; a server
+that passes request sizes straight through would compile on the hot path
+for every new row count it sees (and the offline path has the same
+disease: ``ops/predict.py``'s forest jits specialize on ``N``).  The fix
+is the standard serving trick (TF Serving's batching ladder, XLA's
+bucketed dynamic dimensions): rows are padded up to a small fixed ladder
+of power-of-two bucket sizes with a validity mask, so the universe of
+compiled programs is the ladder — finite, known in advance, and fully
+pre-compilable by ``warmup()``.
+
+``CountingJit`` wraps a jitted callable and turns its executable-cache
+growth into obs counters (``<prefix>_compiles``,
+``<prefix>_compiles_bucket_<B>``), which is what the "zero new compiles
+after warmup" acceptance gate reads.
+
+``MicroBatcher`` is the concurrency half: concurrent ``submit()`` calls
+coalesce into one device batch under a max-latency deadline, so p99
+stays bounded while small requests ride along with big ones.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import obs
+
+
+def default_ladder(lo: int = 16, hi: int = 65536) -> List[int]:
+    """Power-of-two bucket sizes from ``lo`` to ``hi`` inclusive."""
+    lo = max(int(lo), 1)
+    hi = max(int(hi), lo)
+    sizes = []
+    b = lo
+    while b < hi:
+        sizes.append(b)
+        b <<= 1
+    sizes.append(hi)
+    return sizes
+
+
+class BucketLadder:
+    """A sorted set of batch sizes every request is padded up to."""
+
+    def __init__(self, sizes: Optional[Sequence[int]] = None):
+        sizes = list(sizes) if sizes else default_ladder()
+        self.sizes = sorted({int(s) for s in sizes})
+        if not self.sizes or self.sizes[0] <= 0:
+            raise ValueError(f"bucket sizes must be positive: {sizes}")
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (the largest bucket for oversize n)."""
+        for s in self.sizes:
+            if s >= n:
+                return s
+        return self.sizes[-1]
+
+    def chunks(self, n: int) -> List[Tuple[int, int, int]]:
+        """Split ``n`` rows into ``(offset, rows, bucket)`` chunks.
+
+        Oversize inputs stream through the largest bucket; the remainder
+        drops back down the ladder so a 70k-row file predict costs one
+        65536 call plus one small-bucket call, not a fresh 70k compile."""
+        out: List[Tuple[int, int, int]] = []
+        hi = self.sizes[-1]
+        off = 0
+        while n - off > hi:
+            out.append((off, hi, hi))
+            off += hi
+        out.append((off, n - off, self.bucket_for(n - off)))
+        return out
+
+
+class CountingJit:
+    """Wrap a ``jax.jit`` callable; surface its compiles as obs counters.
+
+    The jit's executable cache size is read before/after each call: a
+    growth means this call shape-missed and XLA compiled.  Counters:
+    ``<prefix>_compiles`` (total), ``<prefix>_compiles_bucket_<B>`` (per
+    bucket), ``<prefix>_calls``.  When the private ``_cache_size`` API is
+    unavailable the wrapper falls back to counting distinct shape keys it
+    has seen — same signal for the bucket-ladder use case, where shapes
+    are the only specialization axis."""
+
+    def __init__(self, fn: Callable, prefix: str):
+        self._fn = fn
+        self.prefix = prefix
+        self._seen_keys = set()
+
+    def _cache_size(self) -> Optional[int]:
+        probe = getattr(self._fn, "_cache_size", None)
+        if probe is None:
+            return None
+        try:
+            return int(probe())
+        except Exception:  # pragma: no cover - jax internals moved
+            return None
+
+    def __call__(self, bucket: int, *args, **kwargs):
+        before = self._cache_size()
+        out = self._fn(*args, **kwargs)
+        obs.inc(f"{self.prefix}_calls")
+        after = self._cache_size()
+        if after is not None:
+            compiled = before is not None and after > before
+        else:  # pragma: no cover - fallback for jax without _cache_size
+            key = tuple(
+                (getattr(a, "shape", None), str(getattr(a, "dtype", "")))
+                for a in args) + tuple(sorted(kwargs.items()))
+            compiled = key not in self._seen_keys
+            self._seen_keys.add(key)
+        if compiled:
+            obs.inc(f"{self.prefix}_compiles")
+            obs.inc(f"{self.prefix}_compiles_bucket_{bucket}")
+        return out
+
+
+def pad_rows(X: np.ndarray, bucket: int):
+    """Pad ``X`` ([n, F]) with zero rows up to ``bucket``; return
+    ``(padded, mask)`` where mask marks the real rows."""
+    n = X.shape[0]
+    mask = np.zeros(bucket, dtype=bool)
+    mask[:n] = True
+    if n == bucket:
+        return X, mask
+    pad = np.zeros((bucket - n,) + X.shape[1:], dtype=X.dtype)
+    return np.concatenate([X, pad], axis=0), mask
+
+
+class _Pending:
+    __slots__ = ("rows", "done", "result", "error", "t0")
+
+    def __init__(self, rows: np.ndarray):
+        self.rows = rows
+        self.done = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.t0 = time.perf_counter()
+
+
+class MicroBatcher:
+    """Coalesce concurrent predict requests into device batches.
+
+    One worker thread drains a queue: it waits up to ``max_delay_s``
+    (measured from the oldest queued request) for more work, closes the
+    batch at ``max_batch`` rows, runs ``predict_fn`` once on the
+    concatenated rows, and splits the result back per request.  Requests
+    larger than ``max_batch`` run alone (the bucket ladder underneath
+    streams them in largest-bucket chunks).
+
+    obs account: ``serve_requests``/``serve_rows`` at submit,
+    ``serve_batches``/``serve_batch_rows`` per device batch, and
+    ``serve_latency_p50_ms``/``serve_latency_p99_ms`` gauges over a ring
+    of recent request latencies (enqueue -> result ready).
+    """
+
+    _LATENCY_RING = 2048
+
+    def __init__(self, predict_fn: Callable[[np.ndarray], np.ndarray],
+                 max_batch: int = 8192, max_delay_s: float = 0.005):
+        self.predict_fn = predict_fn
+        self.max_batch = max(int(max_batch), 1)
+        self.max_delay_s = max(float(max_delay_s), 0.0)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: List[_Pending] = []
+        self._closed = False
+        self._latencies: List[float] = []
+        self._lat_seq = 0
+        self._worker = threading.Thread(target=self._run,
+                                        name="lgbt-serve-batcher",
+                                        daemon=True)
+        self._worker.start()
+
+    # -- client side -----------------------------------------------------
+    def submit(self, rows: np.ndarray, timeout: Optional[float] = None):
+        """Block until the batch containing ``rows`` is served; returns
+        whatever ``predict_fn`` produced for this request's row span."""
+        rows = np.ascontiguousarray(rows)
+        req = _Pending(rows)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            self._queue.append(req)
+            self._cond.notify_all()
+        obs.inc("serve_requests")
+        obs.inc("serve_rows", int(rows.shape[0]))
+        if not req.done.wait(timeout):
+            # shed the request: a timed-out entry left in the queue
+            # would still be computed AND hold max_batch capacity ahead
+            # of live requests, compounding the overload it signals
+            with self._cond:
+                if req in self._queue:
+                    self._queue.remove(req)
+            obs.inc("serve_timeouts_shed")
+            raise TimeoutError("predict request timed out")
+        if req.error is not None:
+            raise req.error
+        self._note_latency((time.perf_counter() - req.t0) * 1000.0)
+        return req.result
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the worker; with ``drain`` (default) queued requests are
+        served first, otherwise they fail with RuntimeError."""
+        with self._cond:
+            self._closed = True
+            if not drain:
+                for req in self._queue:
+                    req.error = RuntimeError("MicroBatcher closed")
+                    req.done.set()
+                self._queue.clear()
+            self._cond.notify_all()
+        self._worker.join(timeout=30.0)
+
+    # -- worker side -----------------------------------------------------
+    def _take_batch(self) -> Optional[List[_Pending]]:
+        """Wait for work, then gather until max_batch rows or the oldest
+        request's deadline passes.  Returns None on shutdown."""
+        with self._cond:
+            while not self._queue:
+                if self._closed:
+                    return None
+                self._cond.wait(timeout=0.1)
+            deadline = self._queue[0].t0 + self.max_delay_s
+            while not self._closed:
+                rows = sum(r.rows.shape[0] for r in self._queue)
+                left = deadline - time.perf_counter()
+                if rows >= self.max_batch or left <= 0:
+                    break
+                self._cond.wait(timeout=left)
+            batch: List[_Pending] = []
+            total = 0
+            while self._queue:
+                nxt = self._queue[0].rows.shape[0]
+                if batch and total + nxt > self.max_batch:
+                    break
+                batch.append(self._queue.pop(0))
+                total += nxt
+            return batch
+
+    def _run(self) -> None:
+        from ..utils import timetag
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            if not batch:          # spurious wakeup at shutdown
+                continue
+            try:
+                with timetag.scope("Serve::batch"):
+                    rows = (batch[0].rows if len(batch) == 1 else
+                            np.concatenate([r.rows for r in batch], axis=0))
+                    out = self.predict_fn(rows)
+                obs.inc("serve_batches")
+                obs.inc("serve_batch_rows", int(rows.shape[0]))
+                obs.set_gauge("serve_last_batch_rows", int(rows.shape[0]))
+                off = 0
+                for req in batch:
+                    n = req.rows.shape[0]
+                    req.result = _slice_rows(out, off, n)
+                    off += n
+                    req.done.set()
+            except BaseException as exc:  # propagate to every waiter
+                for req in batch:
+                    req.error = exc
+                    req.done.set()
+
+    _GAUGE_EVERY = 32
+
+    def _note_latency(self, ms: float) -> None:
+        # the percentile refresh copies the ring and sorts it twice —
+        # too much bookkeeping to pay per request under load, so gauges
+        # update on the first request and every _GAUGE_EVERY after
+        with self._lock:
+            self._latencies.append(ms)
+            if len(self._latencies) > self._LATENCY_RING:
+                del self._latencies[:len(self._latencies)
+                                    - self._LATENCY_RING]
+            self._lat_seq += 1
+            if self._lat_seq % self._GAUGE_EVERY != 1 \
+                    and self._GAUGE_EVERY > 1:
+                return
+            lat = np.asarray(self._latencies)
+        obs.set_gauge("serve_latency_p50_ms",
+                      round(float(np.percentile(lat, 50)), 3))
+        obs.set_gauge("serve_latency_p99_ms",
+                      round(float(np.percentile(lat, 99)), 3))
+
+
+def _slice_rows(out, off: int, n: int):
+    """Split a batched prediction back to one request's rows.  Supports
+    the (raw, transformed) tuple the serving path returns as well as a
+    single array; rows are the LAST axis ([K, N] class-major)."""
+    if isinstance(out, tuple):
+        return tuple(_slice_rows(o, off, n) for o in out)
+    return out[..., off:off + n]

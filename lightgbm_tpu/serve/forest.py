@@ -1,0 +1,414 @@
+"""Freeze a trained booster into an immutable, device-resident forest.
+
+``Booster.predict`` historically walked trees one at a time through
+per-tree Python loops (host walk) or re-jitted ``ops/predict.py`` forest
+programs that specialize on every new batch shape.  Inference throughput
+on accelerators comes from the opposite shape (XGBoost: Mitchell &
+Frank, arXiv:1806.11248; Booster: He et al., arXiv:2011.02022): a frozen
+structure-of-arrays forest traversed data-parallel in one fused program.
+
+``CompiledForest`` is that artifact:
+
+- every tree is padded to a common leaf count and stacked into
+  ``[num_class, T, L]`` SoA tensors (1-leaf trees use the absorbing
+  ``left=right=~0`` encoding so the same walk handles them);
+- feature *cut tables* are derived from the forest's own split
+  thresholds (sorted unique thresholds per feature), NOT from the
+  training bin mappers — so loaded model files compile too, and the
+  tables are as small as the forest actually needs.  ``value <= t`` is
+  exactly ``searchsorted(cuts, value, 'left') <= index(t)`` for sorted
+  unique cuts, so integer bin compares reproduce the host walk's double
+  compares bit-for-bit when binning runs on the host in f64;
+- one fused jit does raw-float -> cut lookup, the all-tree absorbing
+  walk, and the objective's output transform (sigmoid / softmax /
+  identity) in a single compile per bucket size (the serving hot path;
+  its on-device binning compares in f32 — rows closer to a threshold
+  than f32 resolution may route differently from the f64 host compare,
+  the standard fp32-inference trade documented in docs/SERVING.md);
+- batch shapes are bucketed through ``serve/batcher.py``'s ladder, and
+  ``warmup()`` pre-compiles every bucket so arbitrary request sizes
+  never hit XLA on the hot path.  Per-bucket compile counters land in
+  the obs registry (``serve_forest_compiles_bucket_<B>`` /
+  ``predict_forest_compiles_bucket_<B>``).
+
+``Booster.compile()`` / the large-array fast path in
+``Booster._predict_array`` feed host-binned (f64-exact) bins to the same
+stacked walk, so offline batch predict and the serving path share one
+artifact and one compiled program universe.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import obs
+from ..utils import timetag
+from ..utils.log import LightGBMError
+from .batcher import BucketLadder, CountingJit, pad_rows
+
+_I32_SENTINEL = np.iinfo(np.int32).max
+
+
+def _tree_class_lists(models, num_class: int, n_models: int):
+    """Class-major model rows -> per-class tree lists (row i is class
+    i % num_class, like the reference's class-major model vector)."""
+    return [[models[i] for i in range(n_models) if i % num_class == k]
+            for k in range(num_class)]
+
+
+def build_cut_tables(trees) -> Tuple[Dict[int, np.ndarray],
+                                     Dict[int, np.ndarray]]:
+    """Per-feature sorted unique split thresholds across the forest.
+
+    Returns ``(numerical, categorical)`` keyed by real feature index;
+    numerical tables are f64 threshold values, categorical tables are
+    the int64 category codes the host walk compares with
+    (``int64(value) == int64(threshold)``)."""
+    num: Dict[int, set] = {}
+    cat: Dict[int, set] = {}
+    for tree in trees:
+        n = tree.num_leaves - 1
+        for i in range(n):
+            f = int(tree.split_feature[i])
+            if int(tree.decision_type[i]) == 1:
+                cat.setdefault(f, set()).add(int(np.int64(tree.threshold[i])))
+            else:
+                num.setdefault(f, set()).add(float(tree.threshold[i]))
+    both = set(num) & set(cat)
+    if both:
+        raise LightGBMError(
+            f"features {sorted(both)} carry both numerical and categorical "
+            f"splits; cannot build a single cut table per feature")
+    return ({f: np.asarray(sorted(v), np.float64) for f, v in num.items()},
+            {f: np.asarray(sorted(v), np.int64) for f, v in cat.items()})
+
+
+def stack_class_trees(trees, num_leaves: int, cuts_num, cuts_cat):
+    """Stack one class's trees into SoA arrays ``[T, L-1]`` / ``[T, L]``.
+
+    ``split_bin`` holds each node's threshold INDEX in its feature's cut
+    table; 1-leaf trees get the absorbing ``left=right=~0`` node so the
+    shared walk terminates them at leaf 0."""
+    T = len(trees)
+    L = max(num_leaves, 2)
+    M = L - 1
+    sf = np.zeros((T, M), np.int32)
+    sb = np.zeros((T, M), np.int32)
+    ic = np.zeros((T, M), bool)
+    lc = np.full((T, M), ~0, np.int32)
+    rc = np.full((T, M), ~0, np.int32)
+    lv = np.zeros((T, L), np.float32)
+    for t, tree in enumerate(trees):
+        k = tree.num_leaves - 1
+        if k <= 0:
+            lv[t, 0] = tree.leaf_value[0] if tree.num_leaves else 0.0
+            continue
+        sf[t, :k] = tree.split_feature[:k]
+        ic[t, :k] = tree.decision_type[:k] == 1
+        for i in range(k):
+            f = int(tree.split_feature[i])
+            if ic[t, i]:
+                sb[t, i] = int(np.searchsorted(
+                    cuts_cat[f], np.int64(tree.threshold[i])))
+            else:
+                sb[t, i] = int(np.searchsorted(
+                    cuts_num[f], np.float64(tree.threshold[i])))
+        lc[t, :k] = tree.left_child[:k]
+        rc[t, :k] = tree.right_child[:k]
+        lv[t, :tree.num_leaves] = tree.leaf_value[:tree.num_leaves]
+    return sf, sb, ic, lc, rc, lv
+
+
+class CompiledForest:
+    """Immutable inference artifact: stacked SoA forest + cut tables +
+    shape-bucketed compiled programs.  Build with :meth:`from_booster`."""
+
+    def __init__(self):
+        raise TypeError("use CompiledForest.from_booster()")
+
+    @classmethod
+    def from_booster(cls, booster, num_iteration: int = -1,
+                     buckets: Optional[Sequence[int]] = None
+                     ) -> "CompiledForest":
+        """Freeze ``booster`` (a ``Booster`` or a ``models/gbdt.py``
+        engine) into a CompiledForest.  ``num_iteration`` limits the
+        forest like ``Booster.predict``; ``buckets`` overrides the batch
+        bucket ladder (default: powers of two, 16..65536)."""
+        import jax.numpy as jnp
+
+        b = getattr(booster, "_booster", booster)
+        models = list(b.models)
+        K = max(int(b.num_class), 1)
+        n_models = len(models)
+        if num_iteration > 0:
+            n_models = min(n_models, num_iteration * K)
+        models = models[:n_models]
+        self = object.__new__(cls)
+        self.num_class = K
+        self.num_features = int(b.max_feature_idx) + 1
+        self.num_trees = n_models
+        self.num_leaves = max([t.num_leaves for t in models] + [2])
+        self.sigmoid = float(getattr(b, "sigmoid", -1.0) or -1.0)
+        self.transform = ("softmax" if K > 1
+                          else "sigmoid" if self.sigmoid > 0 else "identity")
+        self.ladder = BucketLadder(buckets)
+
+        # -- cut tables (host f64/int64 exact + device f32/int32 copies)
+        self._cuts_num, self._cuts_cat = build_cut_tables(models)
+        F = self.num_features
+        for f in list(self._cuts_num) + list(self._cuts_cat):
+            if f >= F:       # loaded model with max_feature_idx unset/low
+                F = self.num_features = f + 1
+        self.max_cuts = max(
+            [len(v) for v in self._cuts_num.values()]
+            + [len(v) for v in self._cuts_cat.values()] + [1])
+        self._nan_bin = np.int32(self.max_cuts + 1)   # > any threshold index
+        bnd = np.full((F, self.max_cuts), np.inf, np.float32)
+        cats = np.full((F, self.max_cuts), _I32_SENTINEL, np.int32)
+        is_cat = np.zeros(F, bool)
+        for f, v in self._cuts_num.items():
+            bnd[f, :len(v)] = v.astype(np.float32)
+        for f, v in self._cuts_cat.items():
+            cats[f, :len(v)] = np.clip(v, -2**31, _I32_SENTINEL - 1)
+            is_cat[f] = True
+        self._bnd_dev = jnp.asarray(bnd)
+        self._cats_dev = jnp.asarray(cats)
+        self._is_cat_dev = jnp.asarray(is_cat)
+        self._is_cat_feat = is_cat
+
+        # -- stacked SoA trees: [K, T, L-1] / [K, T, L]
+        per_class = _tree_class_lists(models, K, n_models)
+        T = max([len(ts) for ts in per_class] + [0])
+        zero = _zero_tree(self.num_leaves)
+        stacks = []
+        for ts in per_class:
+            arrs = stack_class_trees(ts, self.num_leaves,
+                                     self._cuts_num, self._cuts_cat)
+            if len(ts) < T:    # ragged tail: pad with absorbing 0-trees
+                arrs = tuple(
+                    np.concatenate([a, np.repeat(z, T - len(ts), axis=0)],
+                                   axis=0)
+                    for a, z in zip(arrs, zero))
+            stacks.append(arrs)
+        self.trees_per_class = T
+        self._tree_dev = tuple(
+            jnp.asarray(np.stack([s[i] for s in stacks], axis=0))
+            for i in range(6))
+        obs.inc("forest_compile_artifacts")
+        obs.set_gauge("forest_trees", int(n_models))
+        obs.set_gauge("forest_leaves_padded", int(self.num_leaves))
+
+        # -- fused programs (one compile per bucket size)
+        self._binned_jit = CountingJit(self._make_binned_fn(),
+                                       "predict_forest")
+        self._raw_jit = CountingJit(self._make_raw_fn(), "serve_forest")
+        return self
+
+    # ------------------------------------------------------------------
+    # fused programs
+    def _walk(self, tree_dev, bins):
+        """Per-class Kahan forest sums on ``bins`` [F, B] -> [K, B]."""
+        import jax
+        import jax.numpy as jnp
+        from ..ops.predict import predict_binned_forest
+
+        sf, sb, ic, lc, rc, lv = tree_dev
+        with jax.named_scope("forest_walk"):
+            outs = [predict_binned_forest(sf[k], sb[k], ic[k], lc[k],
+                                          rc[k], lv[k], bins,
+                                          self.num_leaves)
+                    for k in range(self.num_class)]
+            return jnp.stack(outs, axis=0)
+
+    def _transform(self, raw):
+        """The objective's output transform, fused into the program."""
+        import jax
+        import jax.numpy as jnp
+        with jax.named_scope("transform"):
+            if self.transform == "softmax":
+                e = jnp.exp(raw - raw.max(axis=0, keepdims=True))
+                return e / e.sum(axis=0, keepdims=True)
+            if self.transform == "sigmoid":
+                return 1.0 / (1.0 + jnp.exp(-self.sigmoid * raw))
+            return raw
+
+    def _make_binned_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        def binned_fn(tree_dev, bins, mask):
+            raw = self._walk(tree_dev, bins)
+            raw = jnp.where(mask[None, :], raw, 0.0)
+            return raw
+        return jax.jit(binned_fn)
+
+    def _make_raw_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        def raw_fn(tree_dev, bnd, cats, is_cat, X, mask):
+            # raw floats [B, F] -> cut-table bins [F, B], on device
+            with jax.named_scope("bin_lookup"):
+                Xt = X.T
+                isnan = jnp.isnan(Xt)
+                safe = jnp.where(isnan, 0.0, Xt)
+                nbin = jax.vmap(
+                    lambda c, v: jnp.searchsorted(c, v, side="left"))(
+                        bnd, safe).astype(jnp.int32)
+                nbin = jnp.where(isnan, self._nan_bin, nbin)
+                iv = safe.astype(jnp.int32)
+                j = jax.vmap(
+                    lambda c, v: jnp.searchsorted(c, v, side="left"))(
+                        cats, iv).astype(jnp.int32)
+                jc = jnp.minimum(j, cats.shape[1] - 1)
+                hit = jnp.take_along_axis(cats, jc, axis=1) == iv
+                cbin = jnp.where(hit & ~isnan, jc, -1)
+                bins = jnp.where(is_cat[:, None], cbin, nbin)
+            raw = self._walk(tree_dev, bins)
+            raw = jnp.where(mask[None, :], raw, 0.0)
+            out = self._transform(raw)
+            out = jnp.where(mask[None, :], out, 0.0)
+            return raw, out
+        return jax.jit(raw_fn)
+
+    # ------------------------------------------------------------------
+    # host-side exact binning (f64 compares, identical routing to the
+    # host tree walk; feeds the binned program)
+    def bin_rows(self, X: np.ndarray) -> np.ndarray:
+        """[N, F] raw f64 -> [F, N] int32 cut-table bins (exact)."""
+        N = X.shape[0]
+        bins = np.zeros((self.num_features, N), np.int32)
+        for f, cuts in self._cuts_num.items():
+            col = X[:, f]
+            isnan = np.isnan(col)
+            b = np.searchsorted(cuts, np.where(isnan, 0.0, col),
+                                side="left")
+            bins[f] = np.where(isnan, self._nan_bin, b)
+        for f, cats in self._cuts_cat.items():
+            col = X[:, f]
+            isnan = np.isnan(col)
+            iv = np.where(isnan, 0, col).astype(np.int64)
+            j = np.searchsorted(cats, iv, side="left")
+            jc = np.minimum(j, len(cats) - 1)
+            hit = (cats[jc] == iv) & ~isnan
+            bins[f] = np.where(hit, jc, -1)
+        return bins
+
+    def host_transform(self, raw: np.ndarray) -> np.ndarray:
+        """The same output transform as the fused program, in host f64.
+        Delegates to the prediction objective (models/gbdt.py) so the
+        host formula has exactly one source."""
+        from ..models.gbdt import _objective_for_prediction
+        obj = _objective_for_prediction(
+            self.transform,
+            self.sigmoid if self.transform == "sigmoid" else -1.0,
+            self.num_class)
+        return np.asarray(obj.convert_output(np.asarray(raw)))
+
+    # ------------------------------------------------------------------
+    def _check_width(self, X: np.ndarray) -> np.ndarray:
+        if X.ndim != 2:
+            X = np.atleast_2d(X)
+        if X.shape[1] < self.num_features:
+            raise LightGBMError(
+                f"input has {X.shape[1]} features; the forest needs "
+                f"{self.num_features}")
+        return X[:, :self.num_features]
+
+    def raw_scores(self, X) -> np.ndarray:
+        """[K, N] f64 raw scores via host-exact binning + the stacked
+        walk, bucketed so repeat calls never re-specialize on N."""
+        X = self._check_width(np.asarray(X, np.float64))
+        N = X.shape[0]
+        if N == 0 or self.num_trees == 0:
+            return np.zeros((self.num_class, N), np.float64)
+        parts = []
+        for off, n, bucket in self.ladder.chunks(N):
+            Xp, mask = pad_rows(X[off:off + n], bucket)
+            bins = self.bin_rows(Xp)
+            with timetag.scope("Predict::forest"):
+                raw = self._binned_jit(bucket, self._tree_dev, bins, mask)
+            parts.append(np.asarray(raw, np.float64)[:, :n])
+        return np.concatenate(parts, axis=1)
+
+    def _device_scores(self, X) -> Tuple[np.ndarray, np.ndarray]:
+        """(raw, transformed) [K, N] f32 via the fully fused raw-float
+        program (serving hot path; on-device f32 binning)."""
+        X = self._check_width(np.asarray(X, np.float32))
+        N = X.shape[0]
+        if N == 0 or self.num_trees == 0:
+            z = np.zeros((self.num_class, N), np.float32)
+            return z, self.host_transform(z.astype(np.float64))
+        raws, outs = [], []
+        for off, n, bucket in self.ladder.chunks(N):
+            Xp, mask = pad_rows(X[off:off + n], bucket)
+            with timetag.scope("Predict::forest"):
+                raw, out = self._raw_jit(bucket, self._tree_dev,
+                                         self._bnd_dev, self._cats_dev,
+                                         self._is_cat_dev, Xp, mask)
+            raws.append(np.asarray(raw)[:, :n])
+            outs.append(np.asarray(out)[:, :n])
+        return (np.concatenate(raws, axis=1), np.concatenate(outs, axis=1))
+
+    def predict(self, X, raw_score: bool = False,
+                device_binning: bool = False) -> np.ndarray:
+        """Predictions shaped like ``Booster.predict``: ``[N]`` for one
+        class, ``[N, K]`` for multiclass.  ``device_binning`` selects the
+        fully fused raw-float program (f32 binning, in-jit transform —
+        the serving path); the default bins on the host in f64, with the
+        transform in f64, for exact parity with ``Booster.predict``."""
+        if device_binning:
+            raw, out = self._device_scores(X)
+            res = raw if raw_score else out
+        else:
+            raw = self.raw_scores(X)
+            res = raw if raw_score else self.host_transform(raw)
+        res = np.asarray(res)
+        return res[0] if res.shape[0] == 1 else res.T
+
+    def batched_fn(self):
+        """``rows -> (raw, transformed)`` [K, n] callable for the
+        micro-batcher (device-binned serving path)."""
+        return self._device_scores
+
+    # ------------------------------------------------------------------
+    def warmup(self, buckets: Optional[Sequence[int]] = None,
+               max_bucket: Optional[int] = None) -> "CompiledForest":
+        """Pre-compile every bucket for both programs so the hot path
+        never hits XLA.  ``max_bucket`` trims the ladder (a server whose
+        ``serve_max_batch`` is 4096 need not compile the 65536 bucket)."""
+        sizes = list(buckets) if buckets else list(self.ladder.sizes)
+        if max_bucket:
+            kept = [s for s in sizes if s <= max_bucket]
+            sizes = kept or sizes[:1]
+        for s in sizes:
+            dummy = np.zeros((min(s, 2), self.num_features))
+            Xp, mask = pad_rows(np.asarray(dummy, np.float64), s)
+            self._binned_jit(s, self._tree_dev, self.bin_rows(Xp), mask)
+            Xp32, mask = pad_rows(np.asarray(dummy, np.float32), s)
+            self._raw_jit(s, self._tree_dev, self._bnd_dev, self._cats_dev,
+                          self._is_cat_dev, Xp32, mask)
+        obs.inc("forest_warmups")
+        return self
+
+    def info(self) -> Dict[str, object]:
+        return {
+            "num_trees": int(self.num_trees),
+            "num_class": int(self.num_class),
+            "num_features": int(self.num_features),
+            "num_leaves_padded": int(self.num_leaves),
+            "transform": self.transform,
+            "buckets": list(self.ladder.sizes),
+            "max_cuts": int(self.max_cuts),
+        }
+
+
+def _zero_tree(num_leaves: int):
+    """SoA padding block for one absorbing 0-valued 1-leaf tree."""
+    L = max(num_leaves, 2)
+    M = L - 1
+    return (np.zeros((1, M), np.int32), np.zeros((1, M), np.int32),
+            np.zeros((1, M), bool), np.full((1, M), ~0, np.int32),
+            np.full((1, M), ~0, np.int32), np.zeros((1, L), np.float32))

@@ -1,0 +1,255 @@
+"""Threaded micro-batching HTTP front end over a CompiledForest.
+
+``python -m lightgbm_tpu serve input_model=model.txt serve_port=8080``
+loads a model file, freezes it into a :class:`~.forest.CompiledForest`,
+pre-compiles every bucket (``warmup()``), and serves predictions over
+plain stdlib HTTP — no framework dependency, matching the repo's
+no-new-deps rule.  Concurrent requests coalesce into device batches in
+``serve/batcher.py``'s MicroBatcher under the ``serve_max_delay_ms``
+deadline, so throughput scales with concurrency while p99 stays bounded.
+
+Protocol (JSON in/out; CSV/TSV accepted for rows):
+
+- ``POST /predict``: body ``{"rows": [[...], ...], "raw_score": false}``
+  or ``text/csv`` lines of feature values.  Response
+  ``{"predictions": [...], "num_rows": n}`` — one float per row, or one
+  list of ``num_class`` floats per row for multiclass.
+- ``GET /healthz``: liveness + frozen-forest shape info.
+- ``GET /stats``: the obs registry's serve/predict counters and latency
+  gauges (``serve_latency_p50_ms`` / ``serve_latency_p99_ms``).
+
+Shutdown is graceful: SIGINT/SIGTERM (or ``PredictServer.stop()``)
+stops accepting, drains queued requests through the batcher, then joins
+the HTTP threads.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .. import obs
+from ..utils import log
+from .batcher import MicroBatcher
+from .forest import CompiledForest
+
+
+def _parse_rows(body: bytes, content_type: str):
+    """Request body -> ``([n, F] f32 row matrix, raw_score)`` (JSON
+    list-of-lists / one flat list for a single row, or CSV/TSV text
+    lines; ``raw_score`` only via the JSON envelope)."""
+    raw_score = False
+    if "json" in (content_type or ""):
+        payload = json.loads(body.decode("utf-8"))
+        if isinstance(payload, dict):
+            rows = payload.get("rows", [])
+            raw_score = bool(payload.get("raw_score", False))
+        else:
+            rows = payload
+        arr = np.asarray(rows, dtype=np.float32)
+    else:
+        lines = [ln for ln in body.decode("utf-8").splitlines()
+                 if ln.strip()]
+        delim = "\t" if lines and "\t" in lines[0] else ","
+        arr = np.asarray([[float(v) for v in ln.split(delim)]
+                          for ln in lines], dtype=np.float32)
+    if arr.ndim == 1:
+        arr = arr.reshape(1, -1)
+    return arr, raw_score
+
+
+def _json_predictions(raw: np.ndarray, out: np.ndarray,
+                      raw_score: bool) -> list:
+    """[K, n] scores -> JSON-ready per-row floats / per-row lists."""
+    scores = raw if raw_score else out
+    if scores.shape[0] == 1:
+        return [float(v) for v in scores[0]]
+    return [[float(v) for v in col] for col in scores.T]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "lightgbm-tpu-serve/1.0"
+    protocol_version = "HTTP/1.1"
+
+    # quiet request logging through our logger, not stderr
+    def log_message(self, fmt, *args):  # pragma: no cover - log plumbing
+        log.debug("serve: " + fmt, *args)
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - stdlib handler naming
+        srv: "PredictServer" = self.server.predict_server
+        if self.path == "/healthz":
+            self._reply(200, {"status": "ok", **srv.forest.info()})
+        elif self.path == "/stats":
+            snap = obs.snapshot()
+            self._reply(200, {
+                "counters": {k: v for k, v in snap["counters"].items()
+                             if k.startswith(("serve_", "predict_forest",
+                                              "forest_"))},
+                "gauges": {k: v for k, v in snap["gauges"].items()
+                           if k.startswith(("serve_", "forest_"))},
+            })
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):  # noqa: N802 - stdlib handler naming
+        srv: "PredictServer" = self.server.predict_server
+        if self.path != "/predict":
+            self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            rows, raw_score = _parse_rows(
+                body, self.headers.get("Content-Type", ""))
+            # validate per request BEFORE coalescing: a malformed width
+            # must 400 here, not poison every request sharing its batch
+            if rows.shape[0] == 0:
+                raise ValueError("no rows in request")
+            if rows.shape[1] != srv.forest.num_features:
+                raise ValueError(
+                    f"expected {srv.forest.num_features} features per "
+                    f"row, got {rows.shape[1]}")
+        except Exception as exc:
+            obs.inc("serve_bad_requests")
+            self._reply(400, {"error": f"bad request: {exc}"})
+            return
+        try:
+            raw, out = srv.batcher.submit(rows, timeout=srv.request_timeout)
+            self._reply(200, {
+                "predictions": _json_predictions(raw, out, raw_score),
+                "num_rows": int(rows.shape[0]),
+            })
+        except TimeoutError:
+            obs.inc("serve_timeouts")
+            self._reply(503, {"error": "prediction timed out"})
+        except RuntimeError:
+            # batcher closed: we are mid graceful shutdown — retryable
+            obs.inc("serve_shedding")
+            self._reply(503, {"error": "server shutting down"})
+        except Exception as exc:
+            obs.inc("serve_errors")
+            self._reply(500, {"error": str(exc)})
+
+
+class PredictServer:
+    """Own the HTTP listener + micro-batcher around one CompiledForest.
+
+    ``start()`` binds and serves on a daemon thread (port 0 picks an
+    ephemeral port — tests use this); ``serve_forever()`` blocks with
+    SIGINT/SIGTERM wired to a graceful stop.
+    """
+
+    def __init__(self, forest: CompiledForest, host: str = "127.0.0.1",
+                 port: int = 8080, max_batch: int = 8192,
+                 max_delay_ms: float = 5.0,
+                 request_timeout: float = 60.0):
+        self.forest = forest
+        self.request_timeout = float(request_timeout)
+        self.batcher = MicroBatcher(forest.batched_fn(),
+                                    max_batch=max_batch,
+                                    max_delay_s=max_delay_ms / 1000.0)
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.daemon_threads = True
+        self.httpd.predict_server = self
+        self._thread: Optional[threading.Thread] = None
+        self._stop_requested = threading.Event()
+        self._stop_lock = threading.Lock()
+        self._stopped = False
+
+    @property
+    def address(self):
+        """(host, port) actually bound (resolves port 0)."""
+        return self.httpd.server_address[:2]
+
+    def start(self) -> "PredictServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="lgbt-serve-http", daemon=True)
+        self._thread.start()
+        host, port = self.address
+        log.info("serving CompiledForest (%d trees, %d class) on "
+                 "http://%s:%d", self.forest.num_trees,
+                 self.forest.num_class, host, port)
+        return self
+
+    def stop(self) -> None:
+        """Graceful: stop accepting, drain the batcher, close sockets."""
+        self._stop_requested.set()
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        self.httpd.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        self.batcher.close(drain=True)
+        self.httpd.server_close()
+        log.info("serve: shut down cleanly (%d requests, %d batches)",
+                 obs.get_counter("serve_requests"),
+                 obs.get_counter("serve_batches"))
+
+    def serve_forever(self) -> None:
+        """Block until SIGINT/SIGTERM, then shut down gracefully.  The
+        signal handler only *requests* the stop; the blocked main thread
+        performs it synchronously, so the process cannot exit with the
+        drain half done."""
+        def _sig(signum, _frame):  # pragma: no cover - signal delivery
+            log.info("serve: received signal %d, shutting down", signum)
+            self._stop_requested.set()
+
+        prev = {}
+        for s in (signal.SIGINT, signal.SIGTERM):
+            try:
+                prev[s] = signal.signal(s, _sig)
+            except ValueError:  # pragma: no cover - non-main thread
+                pass
+        try:
+            self.start()
+            self._stop_requested.wait()
+        finally:
+            self.stop()
+            for s, h in prev.items():  # pragma: no cover - restore
+                signal.signal(s, h)
+
+
+def serve_from_config(config, params=None) -> PredictServer:
+    """CLI entry (``task=serve``): load ``input_model``, freeze, warm up
+    every bucket up to ``serve_max_batch``, and return a started server
+    (the CLI then blocks in ``serve_forever``)."""
+    from ..basic import Booster
+
+    from .batcher import default_ladder
+
+    if not config.input_model:
+        log.fatal("No model file specified (input_model=...)")
+    booster = Booster(params=dict(params or {}),
+                      model_file=config.input_model)
+    # Cap the ladder at serve_max_batch: warmup() compiles every bucket
+    # the forest can ever pick, so an oversize request streams through
+    # the largest WARMED bucket instead of jit-compiling an unwarmed one
+    # on the hot path.
+    max_batch = int(config.serve_max_batch)
+    buckets = list(config.predict_buckets) or default_ladder()
+    buckets = [b for b in buckets if b <= max_batch] or [max_batch]
+    forest = CompiledForest.from_booster(booster, buckets=buckets)
+    log.info("serve: warming %d bucket(s) for %d trees...",
+             len(forest.ladder.sizes), forest.num_trees)
+    forest.warmup()
+    return PredictServer(
+        forest,
+        host=str(getattr(config, "serve_host", "127.0.0.1") or "127.0.0.1"),
+        port=int(config.serve_port),
+        max_batch=int(config.serve_max_batch),
+        max_delay_ms=float(config.serve_max_delay_ms))

@@ -1,0 +1,124 @@
+"""ops/predict.py branch coverage.
+
+``predict_binned_tree`` picks a per-row feature value two ways: a select
+chain for F <= 64 (cheaper on TPU for narrow GBDT feature counts) and a
+``take_along_axis`` gather for wide feature spaces.  The gather branch
+had no coverage; these tests pin it to the select-chain branch on the
+SAME forest (features above 64 unused, so padding the bin matrix wider
+flips the branch without changing any routing) and to a host reference
+walk.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.predict import (predict_binned_forest,
+                                      predict_binned_tree,
+                                      predict_leaf_indices_forest)
+
+pytestmark = pytest.mark.serve
+
+
+def _toy_tree():
+    """3-leaf tree: node0 splits feat 2 at bin 5 (left -> node1), node1
+    splits feat 7 at bin 2.  Leaves: ~0, ~1, ~2."""
+    sf = np.array([2, 7], np.int32)
+    sb = np.array([5, 2], np.int32)
+    ic = np.array([False, False])
+    lc = np.array([1, ~0], np.int32)
+    rc = np.array([~2, ~1], np.int32)
+    lv = np.array([1.0, 2.0, 4.0], np.float32)
+    return sf, sb, ic, lc, rc, lv
+
+
+def _host_walk(sf, sb, lc, rc, lv, bins):
+    out = np.zeros(bins.shape[1])
+    for row in range(bins.shape[1]):
+        node = 0
+        while node >= 0:
+            node = (lc[node] if bins[sf[node], row] <= sb[node]
+                    else rc[node])
+        out[row] = lv[~node]
+    return out
+
+
+def _random_bins(F, N, seed=0):
+    return np.random.RandomState(seed).randint(0, 10, size=(F, N))
+
+
+@pytest.mark.parametrize("F_wide", [65, 80, 128])
+def test_gather_branch_matches_select_chain(F_wide):
+    """Same forest, same rows: bins [10, N] takes the select chain,
+    bins padded to [F_wide, N] takes the take_along_axis gather.  The
+    outputs must be identical (the extra features are never split on)."""
+    sf, sb, ic, lc, rc, lv = _toy_tree()
+    bins10 = _random_bins(10, 257)
+    wide = np.zeros((F_wide, 257), bins10.dtype)
+    wide[:10] = bins10
+    narrow_val, narrow_leaf = predict_binned_tree(
+        jnp.asarray(sf), jnp.asarray(sb), jnp.asarray(ic),
+        jnp.asarray(lc), jnp.asarray(rc), jnp.asarray(lv),
+        jnp.asarray(bins10), max_steps=3)
+    wide_val, wide_leaf = predict_binned_tree(
+        jnp.asarray(sf), jnp.asarray(sb), jnp.asarray(ic),
+        jnp.asarray(lc), jnp.asarray(rc), jnp.asarray(lv),
+        jnp.asarray(wide), max_steps=3)
+    assert np.array_equal(np.asarray(narrow_val), np.asarray(wide_val))
+    assert np.array_equal(np.asarray(narrow_leaf), np.asarray(wide_leaf))
+    np.testing.assert_allclose(np.asarray(wide_val),
+                               _host_walk(sf, sb, lc, rc, lv, bins10))
+
+
+def test_gather_branch_forest_and_leaf_indices():
+    """Forest-level wrappers through the gather branch (F=70), against
+    the host walk and the narrow branch."""
+    sf, sb, ic, lc, rc, lv = _toy_tree()
+    # two stacked trees with different thresholds
+    sf2 = np.stack([sf, sf])
+    sb2 = np.stack([sb, np.array([3, 7], np.int32)])
+    ic2 = np.stack([ic, ic])
+    lc2 = np.stack([lc, lc])
+    rc2 = np.stack([rc, rc])
+    lv2 = np.stack([lv, lv * 10])
+    bins10 = _random_bins(10, 64, seed=3)
+    wide = np.zeros((70, 64), bins10.dtype)
+    wide[:10] = bins10
+    want = (_host_walk(sf2[0], sb2[0], lc2[0], rc2[0], lv2[0], bins10)
+            + _host_walk(sf2[1], sb2[1], lc2[1], rc2[1], lv2[1], bins10))
+    for b in (bins10, wide):
+        got = predict_binned_forest(
+            jnp.asarray(sf2), jnp.asarray(sb2), jnp.asarray(ic2),
+            jnp.asarray(lc2), jnp.asarray(rc2), jnp.asarray(lv2),
+            jnp.asarray(b), max_steps=3)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+    leaves_narrow = predict_leaf_indices_forest(
+        jnp.asarray(sf2), jnp.asarray(sb2), jnp.asarray(ic2),
+        jnp.asarray(lc2), jnp.asarray(rc2), jnp.asarray(lv2),
+        jnp.asarray(bins10), max_steps=3)
+    leaves_wide = predict_leaf_indices_forest(
+        jnp.asarray(sf2), jnp.asarray(sb2), jnp.asarray(ic2),
+        jnp.asarray(lc2), jnp.asarray(rc2), jnp.asarray(lv2),
+        jnp.asarray(wide), max_steps=3)
+    assert np.array_equal(np.asarray(leaves_narrow),
+                          np.asarray(leaves_wide))
+
+
+def test_gather_branch_categorical_nodes():
+    """Categorical routing (bin == threshold goes left) through the wide
+    gather branch."""
+    sf = np.array([66], np.int32)              # split on a high feature
+    sb = np.array([4], np.int32)
+    ic = np.array([True])
+    lc = np.array([~0], np.int32)
+    rc = np.array([~1], np.int32)
+    lv = np.array([10.0, 20.0], np.float32)
+    bins = np.zeros((70, 9), np.int32)
+    bins[66] = np.array([4, 0, 4, 7, -1, 4, 2, 4, 3])
+    val, leaf = predict_binned_tree(
+        jnp.asarray(sf), jnp.asarray(sb), jnp.asarray(ic),
+        jnp.asarray(lc), jnp.asarray(rc), jnp.asarray(lv),
+        jnp.asarray(bins), max_steps=2)
+    want = np.where(bins[66] == 4, 10.0, 20.0)
+    np.testing.assert_allclose(np.asarray(val), want)

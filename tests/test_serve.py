@@ -1,0 +1,391 @@
+"""Compiled-forest inference subsystem (lightgbm_tpu/serve/).
+
+Tier-1 CPU tests for the serving stack: CompiledForest freeze parity
+(atol=0 against Booster.predict raw scores, binary AND multiclass — the
+PR's acceptance gate), the shape-bucketed compile cache (zero new XLA
+compiles across 10 batch sizes after warmup(), read from the per-bucket
+obs counters), micro-batcher coalescing, and an HTTP round trip through
+the stdlib server.  Also pins the degenerate forests (1-leaf trees,
+empty batches) and the CLI's streaming task=predict.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.serve import (BucketLadder, CompiledForest, MicroBatcher,
+                                PredictServer, default_ladder)
+
+pytestmark = pytest.mark.serve
+
+BUCKETS = [32, 128, 512, 2048]
+
+
+def _train(n=2000, num_class=1, seed=0, num_boost_round=8):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, 6))
+    X[:, 3] = np.round(X[:, 3] * 4) / 4       # boundary-tied values
+    if num_class > 1:
+        y = np.digitize(X[:, 0], [-0.5, 0.5]).astype(np.float64)
+        params = {"objective": "multiclass", "num_class": num_class}
+    else:
+        y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float64)
+        params = {"objective": "binary"}
+    params.update({"num_leaves": 7, "verbose": -1, "min_data_in_leaf": 20})
+    bst = lgb.train(params, lgb.Dataset(X, label=y),
+                    num_boost_round=num_boost_round)
+    return bst, X
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder
+
+
+def test_bucket_ladder_shapes():
+    lad = BucketLadder([64, 16, 256, 16])
+    assert lad.sizes == [16, 64, 256]
+    assert lad.bucket_for(1) == 16
+    assert lad.bucket_for(16) == 16
+    assert lad.bucket_for(17) == 64
+    assert lad.bucket_for(10_000) == 256      # oversize -> largest
+    # oversize inputs stream through the largest bucket + remainder
+    assert lad.chunks(600) == [(0, 256, 256), (256, 256, 256),
+                               (512, 88, 256)]
+    assert lad.chunks(5) == [(0, 5, 16)]
+    assert lad.chunks(0) == [(0, 0, 16)]
+    d = default_ladder(16, 65536)
+    assert d[0] == 16 and d[-1] == 65536
+    assert all(b == 2 * a for a, b in zip(d, d[1:]))
+    with pytest.raises(ValueError):
+        BucketLadder([0, 16])
+
+
+# ---------------------------------------------------------------------------
+# CompiledForest parity (acceptance: atol=0 vs Booster.predict raw)
+
+
+@pytest.mark.parametrize("num_class", [1, 3])
+def test_compiled_forest_matches_booster_raw(num_class):
+    """The PR's API contract: after compile(), Booster.predict and the
+    artifact are the same program, so raw scores agree at atol=0 at any
+    batch size.  This deliberately shares the code path — the
+    INDEPENDENT routing check against the f64 host walk is
+    test_compiled_forest_matches_host_walk below."""
+    bst, X = _train(num_class=num_class)
+    cf = bst.compile(buckets=BUCKETS)
+    got = cf.predict(X, raw_score=True)
+    want = bst.predict(X, raw_score=True)
+    assert got.shape == want.shape
+    assert np.array_equal(got, want)          # atol=0, the acceptance gate
+    # ... including across bucket boundaries / sizes
+    for n in (1, 31, 32, 33, 700):
+        assert np.array_equal(cf.predict(X[:n], raw_score=True),
+                              bst.predict(X[:n], raw_score=True))
+    # transformed output parity (sigmoid / softmax in f64 on this path)
+    assert np.allclose(cf.predict(X), bst.predict(X), rtol=1e-12, atol=0)
+
+
+@pytest.mark.parametrize("num_class", [1, 3])
+def test_compiled_forest_matches_host_walk(num_class):
+    """Routing parity with the per-tree f64 host walk: the cut-table
+    binning must reproduce `value <= threshold` exactly."""
+    bst, X = _train(num_class=num_class)
+    b = bst._booster
+    host = np.zeros((b.num_class, X.shape[0]), np.float64)
+    for i, t in enumerate(b.models):
+        host[i % b.num_class] += t.predict(X)
+    cf = CompiledForest.from_booster(bst, buckets=BUCKETS)
+    raw = cf.raw_scores(X)
+    np.testing.assert_allclose(raw, host, rtol=2e-6, atol=2e-6)
+    # NaN rows must route right, like the host walk
+    Xn = X.copy()
+    Xn[:50, 1] = np.nan
+    hostn = np.zeros((b.num_class, X.shape[0]), np.float64)
+    for i, t in enumerate(b.models):
+        hostn[i % b.num_class] += t.predict(Xn)
+    np.testing.assert_allclose(cf.raw_scores(Xn), hostn,
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_compiled_forest_from_loaded_model_file(tmp_path):
+    """Model files (no training mappers) compile too: the cut tables
+    come from the forest's own thresholds."""
+    bst, X = _train()
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    cf = loaded.compile(buckets=BUCKETS)
+    got = cf.predict(X, raw_score=True)
+    want = bst.compile(buckets=BUCKETS).predict(X, raw_score=True)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_device_binned_path_close_to_host():
+    """The fully fused raw-float program (f32 on-device binning) stays
+    within f32 tolerance of the exact path on generic data."""
+    bst, X = _train()
+    cf = bst.compile(buckets=BUCKETS)
+    dev = cf.predict(X, raw_score=True, device_binning=True)
+    exact = cf.predict(X, raw_score=True)
+    np.testing.assert_allclose(dev, exact, rtol=2e-6, atol=2e-6)
+    prob = cf.predict(X, device_binning=True)
+    np.testing.assert_allclose(prob, cf.predict(X), rtol=2e-5, atol=2e-5)
+
+
+def test_one_leaf_trees_and_empty_batch(tmp_path):
+    """Degenerate forests through the same compiled walk: 1-leaf trees
+    (constant model) and 0-row batches."""
+    model = "\n".join([
+        "gbdt", "num_class=1", "label_index=0", "max_feature_idx=3",
+        "objective=regression", "sigmoid=-1", "feature_names=f0 f1 f2 f3",
+        "feature_infos=none none none none", "",
+        "Tree=0", "num_leaves=1", "leaf_value=0.25", "shrinkage=1", "",
+        "Tree=1", "num_leaves=1", "leaf_value=-0.05", "shrinkage=1", "",
+        "\nfeature importances:", ""])
+    path = tmp_path / "const.txt"
+    path.write_text(model)
+    bst = lgb.Booster(model_file=str(path))
+    cf = bst.compile(buckets=[16, 64])
+    X = np.zeros((5, 4))
+    np.testing.assert_allclose(cf.predict(X, raw_score=True),
+                               np.full(5, 0.2), rtol=1e-6)
+    out = cf.predict(np.zeros((0, 4)), raw_score=True)
+    assert out.shape == (0,)
+    raw, tra = cf.batched_fn()(np.zeros((0, 4)))
+    assert raw.shape == (1, 0) and tra.shape == (1, 0)
+    # empty batch on a real trained forest, multiclass shape contract
+    bst3, _ = _train(num_class=3, num_boost_round=2)
+    cf3 = bst3.compile(buckets=[16])
+    assert cf3.predict(np.zeros((0, 6)), raw_score=True).shape == (0, 3)
+
+
+# ---------------------------------------------------------------------------
+# shape-bucketed compile cache (acceptance: zero compiles after warmup)
+
+
+def test_warmup_then_zero_new_compiles_across_batch_sizes():
+    bst, X = _train(num_boost_round=4)
+    cf = bst.compile(buckets=BUCKETS)
+    cf.warmup()
+    before = obs.snapshot()["counters"]
+    for n in (1, 3, 7, 17, 33, 65, 100, 200, 400, 511):   # 10 sizes
+        cf.predict(X[:n], raw_score=True)
+        cf.predict(X[:n], device_binning=True)
+    after = obs.snapshot()["counters"]
+    new = {k: after[k] - before.get(k, 0) for k in after
+           if "compiles" in k and after[k] != before.get(k, 0)}
+    assert new == {}, f"post-warmup XLA compiles: {new}"
+    # and the per-bucket counters exist from the warmup itself
+    assert any(k.startswith("serve_forest_compiles_bucket_")
+               for k in after), after
+
+
+def test_booster_predict_compile_count_flat_across_mixed_sizes():
+    """The recompile-per-batch-shape fix on the standard predict path:
+    mixed batch sizes (the chunked-file pattern) must reuse the bucket
+    ladder's compiles instead of specializing on every N."""
+    bst, X = _train(n=3000, num_boost_round=4)
+    sizes = [100, 700, 1100, 2900, 1500]
+    for n in sizes:
+        bst.predict(X[:n], raw_score=True)
+    before = obs.get_counter("predict_forest_compiles")
+    for n in sizes + [50, 2000]:                  # new sizes, same buckets
+        bst.predict(X[:n], raw_score=True)
+    assert obs.get_counter("predict_forest_compiles") == before
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher
+
+
+def test_compiled_cache_invalidated_by_rollback_retrain():
+    """rollback_one_iter + retraining restores the model COUNT but not
+    the trees; the cached artifact must not serve stale predictions."""
+    bst, X = _train(num_boost_round=3)
+    bst.compile(buckets=[64, 512, 2048])
+    before = bst.predict(X[:100], raw_score=True)
+    bst.rollback_one_iter()
+    bst.reset_parameter({"learning_rate": 0.5})   # retrained tree differs
+    bst.update()
+    b = bst._booster
+    assert len(b.models) == 3                     # same count as before
+    host = np.zeros(100)
+    for t in b.models:
+        host += t.predict(X[:100])
+    got = bst.predict(X[:100], raw_score=True)
+    np.testing.assert_allclose(got, host, rtol=2e-6, atol=2e-6)
+    assert not np.allclose(got, before)
+
+
+def test_predict_buckets_param_honored():
+    """The documented ``predict_buckets`` param must drive the ladder of
+    every compiled predict path, not just task=serve."""
+    rng = np.random.RandomState(2)
+    X = rng.normal(size=(600, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1, "min_data_in_leaf": 20,
+                     "predict_buckets": "48,96"},
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    cf = bst.compile()
+    assert cf.ladder.sizes == [48, 96]
+    assert np.array_equal(cf.predict(X, raw_score=True),
+                          bst.predict(X, raw_score=True))
+
+
+def test_microbatcher_coalesces_concurrent_requests():
+    calls = []
+
+    def predict_fn(rows):
+        calls.append(rows.shape[0])
+        return (rows.T * 2.0, rows.T * 2.0)   # [F, n] per-"class" doubling
+
+    mb = MicroBatcher(predict_fn, max_batch=64, max_delay_s=0.2)
+    rng = np.random.RandomState(1)
+    reqs = [rng.normal(size=(3, 2)) for _ in range(4)]
+    results = [None] * 4
+    barrier = threading.Barrier(4)
+
+    def worker(i):
+        barrier.wait()
+        results[i] = mb.submit(reqs[i], timeout=30.0)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    mb.close()
+    assert len(calls) < 4, f"no coalescing happened: {calls}"
+    assert sum(calls) == 12
+    for req, res in zip(reqs, results):
+        np.testing.assert_allclose(res[0], req.T * 2.0)
+    snap = obs.snapshot()
+    assert snap["gauges"].get("serve_latency_p50_ms") is not None
+
+
+def test_microbatcher_max_batch_splits_and_errors_propagate():
+    def predict_fn(rows):
+        if rows.shape[0] >= 100:
+            raise ValueError("boom")
+        return rows.T, rows.T
+
+    mb = MicroBatcher(predict_fn, max_batch=8, max_delay_s=0.0)
+    out = mb.submit(np.ones((5, 2)), timeout=30.0)
+    assert out[0].shape == (2, 5)
+    with pytest.raises(ValueError, match="boom"):
+        mb.submit(np.ones((100, 2)), timeout=30.0)
+    mb.close()
+    with pytest.raises(RuntimeError):
+        mb.submit(np.ones((1, 2)))
+
+
+# ---------------------------------------------------------------------------
+# HTTP server round trip
+
+
+def test_http_round_trip_and_graceful_stop():
+    bst, X = _train(num_boost_round=4)
+    cf = bst.compile(buckets=[16, 64])
+    cf.warmup(max_bucket=64)
+    srv = PredictServer(cf, port=0, max_batch=64, max_delay_ms=1.0).start()
+    host, port = srv.address
+    base = f"http://{host}:{port}"
+
+    body = json.dumps({"rows": X[:5].tolist()}).encode()
+    req = urllib.request.Request(base + "/predict", data=body,
+                                 headers={"Content-Type":
+                                          "application/json"})
+    resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    got = np.asarray(resp["predictions"])
+    want = cf.predict(X[:5].astype(np.float32), device_binning=True)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    assert resp["num_rows"] == 5
+
+    # raw_score request + CSV body
+    body = json.dumps({"rows": X[:3].tolist(), "raw_score": True}).encode()
+    req = urllib.request.Request(base + "/predict", data=body,
+                                 headers={"Content-Type":
+                                          "application/json"})
+    resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    np.testing.assert_allclose(
+        np.asarray(resp["predictions"]),
+        cf.predict(X[:3].astype(np.float32), raw_score=True,
+                   device_binning=True), rtol=1e-6, atol=1e-6)
+    csv = "\n".join(",".join(f"{v:.6f}" for v in row)
+                    for row in X[:2]).encode()
+    req = urllib.request.Request(base + "/predict", data=csv,
+                                 headers={"Content-Type": "text/csv"})
+    resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    assert len(resp["predictions"]) == 2
+
+    health = json.loads(urllib.request.urlopen(base + "/healthz",
+                                               timeout=30).read())
+    assert health["status"] == "ok"
+    assert health["num_trees"] == bst.num_trees()
+    stats = json.loads(urllib.request.urlopen(base + "/stats",
+                                              timeout=30).read())
+    assert stats["counters"].get("serve_requests", 0) >= 3
+
+    # malformed body and wrong feature width -> 400 (validated BEFORE
+    # coalescing, so a bad request cannot poison a shared batch)
+    for bad in (b"{nope", json.dumps({"rows": [[1.0, 2.0]]}).encode()):
+        req = urllib.request.Request(base + "/predict", data=bad,
+                                     headers={"Content-Type":
+                                              "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=30)
+        assert err.value.code == 400
+
+    srv.stop()
+    srv.stop()                                # idempotent
+    with pytest.raises(Exception):
+        urllib.request.urlopen(base + "/healthz", timeout=1)
+
+
+# ---------------------------------------------------------------------------
+# CLI: task=serve wiring + streaming task=predict
+
+
+def test_cli_streaming_predict_matches_api(tmp_path, monkeypatch):
+    from lightgbm_tpu import cli
+
+    bst, X = _train(n=1000, num_boost_round=4)
+    model = tmp_path / "m.txt"
+    bst.save_model(str(model))
+    data = tmp_path / "rows.csv"
+    np.savetxt(data, np.column_stack([np.zeros(len(X)), X]),
+               delimiter=",", fmt="%.8g")
+    out = tmp_path / "preds.txt"
+    # force multiple chunks so the streaming writes actually interleave
+    monkeypatch.setattr(lgb.Booster, "_PREDICT_CHUNK_ROWS", 256)
+    rc = cli.main([f"task=predict", f"data={data}",
+                   f"input_model={model}", f"output_result={out}"])
+    assert rc == 0
+    got = np.loadtxt(out)
+    want = lgb.Booster(model_file=str(model)).predict(X)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_cli_serve_subcommand_token(monkeypatch):
+    """``python -m lightgbm_tpu serve ...`` normalizes to task=serve and
+    reaches run_serve with the parsed config."""
+    from lightgbm_tpu import cli
+
+    seen = {}
+
+    def fake_serve(config, params):
+        seen["task"] = config.task
+        seen["port"] = config.serve_port
+        seen["buckets"] = config.predict_buckets
+
+    monkeypatch.setattr(cli, "run_serve", fake_serve)
+    rc = cli.main(["serve", "input_model=nope.txt", "serve_port=12345",
+                   "predict_buckets=16,64"])
+    assert rc == 0
+    assert seen == {"task": "serve", "port": 12345, "buckets": [16, 64]}

@@ -37,8 +37,9 @@ PKG = ROOT / "lightgbm_tpu"
 SCOPE_RE = re.compile(r"timetag\.scope\(\s*[\"']([^\"']+)[\"']")
 NAMED_RE = re.compile(r"jax\.named_scope\(\s*[\"']([^\"']+)[\"']")
 
-# the jitted growth paths carrying the device taxonomy
-DEVICE_FILES = ("ops/grow.py", "ops/ordered_grow.py")
+# the jitted paths carrying the device taxonomy: the growers plus the
+# compiled-forest inference program (serve/forest.py)
+DEVICE_FILES = ("ops/grow.py", "ops/ordered_grow.py", "serve/forest.py")
 
 
 def _load_phases():
@@ -52,6 +53,10 @@ def _load_phases():
 def _scan(paths, rx) -> Dict[str, List[str]]:
     found: Dict[str, List[str]] = {}
     for p in paths:
+        if not p.exists():
+            # a missing device file shows up as its declared phases
+            # being unused — a diagnosable error, not a crash
+            continue
         for m in rx.finditer(p.read_text()):
             found.setdefault(m.group(1), []).append(
                 str(p.relative_to(ROOT)))
