@@ -31,6 +31,12 @@ DESC = {
     "trace_start_iter": "first traced iteration (default 5, skips "
                         "compile/warmup)",
     "trace_num_iters": "trace window length in iterations (default 2)",
+    "metrics_port": "port of the training /metrics listener serving the "
+                    "obs registry in Prometheus text exposition 0.0.4 "
+                    "(0 = off; LIGHTGBM_TPU_METRICS_PORT env wins; "
+                    "docs/OBSERVABILITY.md)",
+    "metrics_host": "bind address of the training /metrics listener "
+                    "(default 127.0.0.1)",
     "use_two_round_loading": "stream the data file in two rounds instead of "
                              "materializing the full float matrix "
                              "(io/streaming.py)",

@@ -147,11 +147,20 @@ def main(argv=None) -> int:
     if not argv:
         print("usage: python -m lightgbm_tpu config=<conf> [key=value ...] "
               "[--events-file=<jsonl>] [--trace-dir=<dir>] "
+              "[metrics_port=<p>] "
               "[snapshot_dir=<dir> snapshot_freq=<K>] "
               "[nan_policy=fail_fast|skip_tree]\n"
               "       python -m lightgbm_tpu serve input_model=<model> "
-              "[serve_port=<p> serve_max_batch=<n> serve_max_delay_ms=<ms>]")
+              "[serve_port=<p> serve_max_batch=<n> serve_max_delay_ms=<ms>]\n"
+              "       python -m lightgbm_tpu obs-report <events.jsonl ...> "
+              "[--format=json|table] [--top=K]")
         return 1
+    # offline run report over --events-file streams: positional file
+    # arguments, so it routes before the key=value parser
+    # (docs/OBSERVABILITY.md §obs-report)
+    if argv[0] == "obs-report":
+        from .obs.report import main as obs_report_main
+        return obs_report_main(argv[1:])
     # subcommand sugar: ``python -m lightgbm_tpu serve ...`` is the
     # reference-style ``task=serve`` (docs/SERVING.md)
     argv = ["task=serve" if tok == "serve" else tok for tok in argv]

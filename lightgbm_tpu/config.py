@@ -209,6 +209,9 @@ _DEFAULTS: Dict[str, Any] = {
     "trace_dir": "",           # device trace dir (LIGHTGBM_TPU_TRACE_DIR wins)
     "trace_start_iter": 5,     # first traced iteration (skip compile/warmup)
     "trace_num_iters": 2,      # trace window length in iterations
+    "metrics_port": 0,         # training /metrics listener port (0 = off;
+                               # LIGHTGBM_TPU_METRICS_PORT env wins)
+    "metrics_host": "127.0.0.1",  # bind address for the metrics listener
 }
 
 _BOOL_KEYS = {k for k, v in _DEFAULTS.items() if isinstance(v, bool)}
@@ -351,6 +354,9 @@ class Config:
             raise ValueError("snapshot_freq must be >= 0")
         if v["serve_max_batch"] <= 0:
             raise ValueError("serve_max_batch must be > 0")
+        if not (0 <= v["metrics_port"] < 65536):
+            raise ValueError("metrics_port must be in [0, 65536) "
+                             "(0 disables the metrics listener)")
         if v["serve_max_delay_ms"] < 0:
             raise ValueError("serve_max_delay_ms must be >= 0")
         if any(b <= 0 for b in v["predict_buckets"]):

@@ -36,6 +36,12 @@ def train(params, train_set, num_boost_round=100,
     iteration — phase timings, eval values, tree shape, cumulative
     collective bytes (lightgbm_tpu/obs/, docs/OBSERVABILITY.md).
 
+    ``metrics_port`` (or ``LIGHTGBM_TPU_METRICS_PORT``) starts a
+    daemon-thread ``GET /metrics`` listener for the duration of the run,
+    serving the obs registry in Prometheus text exposition so standard
+    monitoring can scrape a multi-hour boosting run mid-flight
+    (``obs/metrics_server.py``; stopped cleanly when training exits).
+
     ``snapshot_dir`` + ``snapshot_freq`` params make the run crash-safe
     (docs/FAULT_TOLERANCE.md): every K iterations the full booster state
     is checkpointed atomically, and a later call with the same
@@ -192,6 +198,15 @@ def train(params, train_set, num_boost_round=100,
             and resume_state.get("evals_result"):
         evals_result.update(copy.deepcopy(resume_state["evals_result"]))
 
+    # -- scrapeable /metrics listener (obs/metrics_server.py): started
+    # when metrics_port / LIGHTGBM_TPU_METRICS_PORT asks for one, so a
+    # multi-hour run is visible to standard monitoring mid-flight.
+    # Started HERE, after all setup that can raise, so a bad-params call
+    # can never leak the bound port/thread; the finally below always
+    # stops it.
+    from .obs.metrics_server import maybe_start as _maybe_start_metrics
+    metrics_server = _maybe_start_metrics(params)
+
     # boosting loop (engine.py:143-203)
     try:
         for i in range(init_iteration + resume_done,
@@ -248,6 +263,8 @@ def train(params, train_set, num_boost_round=100,
                 pass
             recorder.close()
             booster._booster.set_event_recorder(None)
+        if metrics_server is not None:
+            metrics_server.stop()
     return booster
 
 

@@ -708,8 +708,17 @@ class GBDT:
             self._trace.close()
 
     def train_one_iter(self, grad=None, hess=None) -> bool:
-        """One boosting round (gbdt.cpp:295-382).  Returns True when training
-        should stop (no more splits possible on every class).
+        """One boosting round (gbdt.cpp:295-382).  Returns True when
+        training should stop (no more splits possible on every class).
+        The whole round is timed by an ``obs.span``: one observe into the
+        ``phase_seconds_gbdt_iteration`` wall-time histogram per call —
+        host bookkeeping only, the async device pipeline is never synced
+        by it (docs/OBSERVABILITY.md)."""
+        with obs.span("GBDT::iteration"):
+            return self._train_one_iter_impl(grad, hess)
+
+    def _train_one_iter_impl(self, grad=None, hess=None) -> bool:
+        """Body of one boosting round.
 
         With ``_pipeline`` the saturation signal arrives one call later than
         the reference's (the saturated iteration is detected when the NEXT
@@ -862,6 +871,10 @@ class GBDT:
             self._cum_comm_bytes += nbytes * self.num_class
             obs.inc("comm_collective_calls", calls * self.num_class)
             obs.inc("comm_collective_bytes", nbytes * self.num_class)
+            # distribution series (comm_bytes / comm_bytes_<kind>): one
+            # sample per tree dispatched this round (parallel/comm.py)
+            from ..parallel.comm import observe_traffic
+            observe_traffic(self._comm_traffic, trees=self.num_class)
         shrink = self.shrinkage_rate
         if not self._pipeline:
             self._pending_iter = cur

@@ -25,19 +25,35 @@ one end-to-end number.  Pieces:
   wraps a window of boosting iterations in ``jax.profiler`` traces that
   break down by the ``jax.named_scope`` phases annotated in
   ``ops/grow.py`` / ``ops/ordered_grow.py``.
+- ``spans``: ``obs.span(name)`` / ``@obs.timed`` — always-on wall-time
+  histograms per phase (``span_series`` maps the ``phases.py`` taxonomy
+  onto metric names).
+- ``prom`` + ``metrics_server``: Prometheus text exposition 0.0.4 over
+  the registry, served at ``GET /metrics`` by the standalone training
+  listener (``metrics_port`` / ``LIGHTGBM_TPU_METRICS_PORT``) and by
+  the serve subsystem's HTTP front end.
+- ``report``: ``python -m lightgbm_tpu obs-report`` — offline summary
+  of an ``--events-file`` stream (per-phase totals, slowest iterations,
+  NaN/saturation incidents, collective traffic, eval trajectory).
 """
 
 from .events import SCHEMA_VERSION, EventRecorder, read_events  # noqa: F401
 from .phases import (DEVICE_PARENT, DEVICE_PHASES,  # noqa: F401
-                     HOST_PHASES, JITTED_HOST_PHASES)
-from .registry import (REGISTRY, Registry, get_counter,  # noqa: F401
-                       get_gauge, inc, merge, reset, restore, set_gauge,
-                       snapshot)
+                     HOST_PHASES, JITTED_HOST_PHASES, span_series)
+from .registry import (DEFAULT_BYTE_BUCKETS,  # noqa: F401
+                       DEFAULT_TIME_BUCKETS, REGISTRY, Registry,
+                       get_counter, get_gauge, get_histogram,
+                       histogram_quantile, inc, merge, observe, reset,
+                       restore, set_gauge, snapshot)
+from .spans import span, timed  # noqa: F401
 from .trace import TraceCapture  # noqa: F401
 
 __all__ = [
-    "REGISTRY", "Registry", "inc", "set_gauge", "get_counter", "get_gauge",
+    "REGISTRY", "Registry", "inc", "set_gauge", "observe", "get_counter",
+    "get_gauge", "get_histogram", "histogram_quantile",
+    "DEFAULT_TIME_BUCKETS", "DEFAULT_BYTE_BUCKETS",
     "snapshot", "merge", "reset", "restore",
+    "span", "timed", "span_series",
     "EventRecorder", "read_events", "SCHEMA_VERSION",
     "TraceCapture",
     "HOST_PHASES", "DEVICE_PHASES", "DEVICE_PARENT", "JITTED_HOST_PHASES",

@@ -22,6 +22,7 @@ file path without importing the package (and its jax dependency).
 """
 
 HOST_PHASES = frozenset({
+    "GBDT::iteration",    # whole boosting round (obs.span, always on)
     "GBDT::boosting",
     "GBDT::bagging",
     "GBDT::tree",
@@ -57,3 +58,27 @@ JITTED_HOST_PHASES = frozenset({
     "GBDT::tree",
     "Predict::forest",
 })
+
+
+def sanitize(name):
+    """Deterministic Prometheus-safe stem for any series/phase name:
+    ``GBDT::tree`` -> ``gbdt_tree``.  The single sanitization rule for
+    the whole metrics namespace — ``span_series`` below and
+    ``obs/prom.py::metric_name`` both build on it, so the phase taxonomy
+    and the exposition names cannot drift apart.  Pure string math only:
+    this module must stay importable by file path without the package."""
+    stem = []
+    for ch in str(name).replace("::", "_").lower():
+        stem.append(ch if (ch.isalnum() or ch == "_") else "_")
+    s = "".join(stem).strip("_") or "unnamed"
+    if s[0].isdigit():
+        s = "_" + s
+    return s
+
+
+def span_series(name):
+    """Histogram series name for a phase's span timer (obs/spans.py):
+    ``GBDT::tree`` -> ``phase_seconds_gbdt_tree``.  The lint
+    (tools/lint_phase_scopes.py) asserts the mapping yields a valid,
+    unique series name for every declared phase."""
+    return "phase_seconds_" + sanitize(name)

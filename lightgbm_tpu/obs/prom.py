@@ -1,0 +1,212 @@
+"""Prometheus text exposition (format 0.0.4) over the obs registry.
+
+``render()`` turns a ``Registry.snapshot()`` into the plain-text format
+every standard scraper understands — counters, gauges, and histograms
+with cumulative ``_bucket{le=...}`` series whose ``+Inf`` bucket equals
+``_count``, all under the ``lightgbm_tpu_`` namespace.  Zero third-party
+deps: the format is line-oriented and tiny, and rendering from a
+snapshot (a plain dict copied under the registry lock) means a scrape
+never blocks a writer for more than the snapshot copy.
+
+``parse_text()`` is the matching minimal parser — enough structure for
+the in-repo tests (and ``tools/bench_regress.py``-style offline checks)
+to validate an exposition without a prometheus client: it returns every
+sample with its labels plus the declared types, and
+``histogram_series()`` reassembles one histogram's cumulative buckets.
+
+TYPE-line policy: every family gets a ``# TYPE`` line; unknown gauge
+values that are not numeric are skipped (the registry allows arbitrary
+gauge payloads; Prometheus does not).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from . import phases
+
+NAMESPACE = "lightgbm_tpu_"
+
+_NAME_OK = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def metric_name(name: str) -> str:
+    """Sanitize a registry series name into a valid Prometheus metric
+    name (``GBDT::tree`` -> ``gbdt_tree``), namespaced.  One rule for
+    the whole namespace: ``phases.sanitize`` (shared with
+    ``span_series``, lint-enforced)."""
+    return NAMESPACE + phases.sanitize(name)
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _labels_str(labels: Optional[Mapping[str, str]],
+                extra: Optional[Mapping[str, str]] = None) -> str:
+    merged: Dict[str, str] = {}
+    if labels:
+        merged.update(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(merged.items()))
+    return "{" + inner + "}"
+
+
+def _fmt(v: float) -> str:
+    """Float formatting: integers render bare (Prometheus accepts both;
+    bare ints keep counter lines exact), non-finites use the spec
+    spellings."""
+    f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if math.isnan(f):
+        return "NaN"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render(snap: Optional[Mapping[str, Any]] = None,
+           labels: Optional[Mapping[str, str]] = None) -> str:
+    """Render a registry snapshot (default: the process registry) as
+    Prometheus text exposition 0.0.4.  ``labels`` (e.g. ``{"rank": "3"}``
+    in multihost runs) are attached to EVERY sample."""
+    if snap is None:
+        from . import registry
+        snap = registry.snapshot()
+    lines: List[str] = []
+
+    for name in sorted(snap.get("counters", {})):
+        m = metric_name(name)
+        lines.append(f"# TYPE {m} counter")
+        lines.append(
+            f"{m}{_labels_str(labels)} "
+            f"{_fmt(snap['counters'][name])}")
+
+    for name in sorted(snap.get("gauges", {})):
+        v = snap["gauges"][name]
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue                    # non-numeric gauge payloads
+        m = metric_name(name)
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m}{_labels_str(labels)} {_fmt(v)}")
+
+    # TIMETAG accumulators (empty unless the serializing mode is on):
+    # one family, phase as a label — the reference taxonomy names
+    # (GBDT::tree) stay readable instead of being mangled per-series.
+    phase = snap.get("phase_seconds") or {}
+    if phase:
+        m = NAMESPACE + "timetag_phase_seconds_total"
+        lines.append(f"# TYPE {m} counter")
+        for name in sorted(phase):
+            lines.append(
+                f"{m}{_labels_str(labels, {'phase': name})} "
+                f"{_fmt(phase[name])}")
+
+    for name in sorted(snap.get("histograms", {})):
+        h = snap["histograms"][name]
+        m = metric_name(name)
+        lines.append(f"# TYPE {m} histogram")
+        cum = 0
+        for bound, c in zip(h["buckets"], h["counts"]):
+            cum += int(c)
+            lines.append(
+                f"{m}_bucket{_labels_str(labels, {'le': _fmt(bound)})} "
+                f"{cum}")
+        cum += int(h["counts"][len(h["buckets"])])
+        lines.append(
+            f"{m}_bucket{_labels_str(labels, {'le': '+Inf'})} {cum}")
+        lines.append(f"{m}_sum{_labels_str(labels)} {_fmt(h['sum'])}")
+        lines.append(
+            f"{m}_count{_labels_str(labels)} {_fmt(h['count'])}")
+
+    return "\n".join(lines) + "\n"
+
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+# ---------------------------------------------------------------------------
+# minimal parser — for in-repo validation, not a general client
+# ---------------------------------------------------------------------------
+
+def _parse_value(s: str) -> float:
+    if s == "+Inf":
+        return math.inf
+    if s == "-Inf":
+        return -math.inf
+    if s == "NaN":
+        return math.nan
+    return float(s)
+
+
+def parse_text(text: str) -> Dict[str, Any]:
+    """Parse an exposition into ``{"types": {family: type}, "samples":
+    [(name, labels_dict, value), ...]}``.  Raises ValueError on any line
+    that is neither a comment, blank, nor a well-formed sample — which
+    is exactly what the format-validity tests want."""
+    types: Dict[str, str] = {}
+    samples: List[Tuple[str, Dict[str, str], float]] = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4:
+                raise ValueError(f"line {lineno}: malformed TYPE: {line!r}")
+            types[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue                    # HELP / comments
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: malformed sample: {line!r}")
+        name, rawlabels, value = m.group(1), m.group(2), m.group(3)
+        labels: Dict[str, str] = {}
+        if rawlabels:
+            # everything in the label body must be consumed by k="v"
+            # pairs plus separators, or the line is malformed
+            body = _LABEL_RE.sub("", rawlabels)
+            if re.sub(r"[,\s]", "", body):
+                raise ValueError(
+                    f"line {lineno}: malformed labels: {rawlabels!r}")
+            for lm in _LABEL_RE.finditer(rawlabels):
+                # single-pass unescape: chained str.replace would corrupt
+                # a literal backslash followed by 'n' or '"'
+                labels[lm.group(1)] = re.sub(
+                    r"\\(.)",
+                    lambda e: {"n": "\n"}.get(e.group(1), e.group(1)),
+                    lm.group(2))
+        samples.append((name, labels, _parse_value(value)))
+    return {"types": types, "samples": samples}
+
+
+def histogram_series(parsed: Mapping[str, Any], family: str,
+                     match: Optional[Mapping[str, str]] = None) \
+        -> Dict[str, Any]:
+    """Reassemble one histogram family from parsed samples:
+    ``{"buckets": [(le, cumulative), ...], "sum": x, "count": n}``.
+    ``match`` filters on non-``le`` labels (e.g. a rank)."""
+    buckets: List[Tuple[float, float]] = []
+    out: Dict[str, Any] = {"buckets": buckets, "sum": None, "count": None}
+    for name, labels, value in parsed["samples"]:
+        if match and any(labels.get(k) != v for k, v in match.items()):
+            continue
+        if name == family + "_bucket" and "le" in labels:
+            buckets.append((_parse_value(labels["le"]), value))
+        elif name == family + "_sum":
+            out["sum"] = value
+        elif name == family + "_count":
+            out["count"] = value
+    buckets.sort(key=lambda t: t[0])
+    return out

@@ -1,33 +1,141 @@
-"""Process-wide registry of monotonic counters and gauges.
+"""Process-wide registry of monotonic counters, gauges and histograms.
 
 Counters only ever increase (reference points: XGBoost's
 ``common::Monitor`` counter dumps, arXiv:1806.11248 §benchmarking);
-gauges record last-written values (live HBM estimate vs. budget).  The
-registry is deliberately process-global, like ``utils/timetag.py``'s
+gauges record last-written values (live HBM estimate vs. budget);
+histograms hold fixed-bucket distributions (span wall times, serve
+latency) with Prometheus-compatible cumulative rendering (obs/prom.py).
+The registry is deliberately process-global, like ``utils/timetag.py``'s
 accumulators: boosters come and go (CV folds, reset_config rebuilds) but
 the run's account persists, and ``merge`` folds a snapshot from another
 process (multi-host runs, fold workers) into this one.
 
-Cost model: one dict update under a lock per call, a handful of calls per
-boosting iteration — cheap enough to leave on unconditionally (the
-acceptance gate for the telemetry layer is "no measurable overhead" on
-bench.py).
+Cost model: one dict update under a lock per call — inc and observe are
+both a lock acquire + O(1)/O(log buckets) work, a handful of calls per
+boosting iteration (or one per serve request) — cheap enough to leave on
+unconditionally (the acceptance gate for the telemetry layer is "no
+measurable overhead" on bench.py; nothing here touches the device or
+forces a host sync).
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
 from collections import defaultdict
-from typing import Any, Dict, Mapping, Optional
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+# Default histogram bucket upper bounds, in SECONDS: span timers range
+# from sub-ms host dispatches to multi-minute cold compiles.  Matches
+# the shape of prometheus_client's default latency buckets, extended up
+# to the compile-time regime.
+DEFAULT_TIME_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+# Byte-sized payloads (collective traffic): 256B .. 4GB, powers of 16/4.
+DEFAULT_BYTE_BUCKETS: Tuple[float, ...] = (
+    256.0, 1024.0, 4096.0, 16384.0, 65536.0, 262144.0, 1048576.0,
+    4194304.0, 16777216.0, 67108864.0, 268435456.0, 1073741824.0,
+    4294967296.0)
+
+
+class _Hist:
+    """One fixed-bucket histogram: non-cumulative bucket counts (the
+    last slot is the +Inf overflow), running sum and count.  Buckets are
+    fixed at first observe; the lock around every mutation lives in the
+    owning Registry."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds: Sequence[float]):
+        self.bounds: Tuple[float, ...] = tuple(float(b) for b in bounds)
+        if list(self.bounds) != sorted(set(self.bounds)):
+            raise ValueError(
+                f"histogram buckets must be strictly increasing: {bounds}")
+        self.counts: List[int] = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+        self.sum += value
+        self.count += 1
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"buckets": list(self.bounds), "counts": list(self.counts),
+                "sum": self.sum, "count": self.count}
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "_Hist":
+        h = cls(d["buckets"])
+        counts = [int(c) for c in d["counts"]]
+        if len(counts) != len(h.counts):
+            raise ValueError("histogram counts/buckets length mismatch")
+        h.counts = counts
+        h.sum = float(d["sum"])
+        h.count = int(d["count"])
+        return h
+
+    def fold(self, other: Mapping[str, Any]) -> None:
+        """Add another histogram's account into this one.  Identical
+        bucket bounds add element-wise; differing bounds re-bucket each
+        incoming (non-cumulative) bucket at its upper edge — values land
+        in the first local bucket whose bound covers the incoming bound,
+        which can only shift samples UP a bucket, never down (the
+        incoming bucket's true values are <= its upper edge)."""
+        bounds = tuple(float(b) for b in other["buckets"])
+        counts = [int(c) for c in other["counts"]]
+        if bounds == self.bounds:
+            for i, c in enumerate(counts):
+                self.counts[i] += c
+        else:
+            for i, c in enumerate(counts):
+                if not c:
+                    continue
+                if i < len(bounds):
+                    j = bisect.bisect_left(self.bounds, bounds[i])
+                else:
+                    j = len(self.bounds)        # +Inf overflow
+                self.counts[j] += c
+        self.sum += float(other["sum"])
+        self.count += int(other["count"])
+
+
+def histogram_quantile(hist: Optional[Mapping[str, Any]],
+                       q: float) -> Optional[float]:
+    """Estimate the q-quantile (0..1) of a histogram snapshot dict by
+    linear interpolation inside the landing bucket — the same estimator
+    as PromQL's ``histogram_quantile``.  Returns None for an empty or
+    missing histogram; the overflow bucket clamps to the last finite
+    bound (there is no upper edge to interpolate toward)."""
+    if not hist or not hist.get("count"):
+        return None
+    bounds = list(hist["buckets"])
+    counts = list(hist["counts"])
+    rank = q * hist["count"]
+    cum = 0.0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= rank and c:
+            if i >= len(bounds):                    # +Inf overflow
+                return float(bounds[-1]) if bounds else None
+            lo = float(bounds[i - 1]) if i else 0.0
+            hi = float(bounds[i])
+            frac = (rank - (cum - c)) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+    return float(bounds[-1]) if bounds else None
 
 
 class Registry:
-    """Counters + gauges with snapshot/merge/reset semantics."""
+    """Counters + gauges + histograms with snapshot/merge/restore/reset
+    semantics."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = defaultdict(int)
         self._gauges: Dict[str, Any] = {}
+        self._histograms: Dict[str, _Hist] = {}
 
     # -- writers ---------------------------------------------------------
     def inc(self, name: str, n: int = 1) -> None:
@@ -40,6 +148,19 @@ class Registry:
         with self._lock:
             self._gauges[name] = value
 
+    def observe(self, name: str, value: float,
+                buckets: Optional[Sequence[float]] = None) -> None:
+        """Record one sample into histogram ``name``.  The bucket bounds
+        are fixed by the FIRST observe (``buckets`` defaults to
+        DEFAULT_TIME_BUCKETS); later calls ignore the argument, so every
+        producer of a series sees the same layout."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = _Hist(
+                    buckets if buckets is not None else DEFAULT_TIME_BUCKETS)
+            h.observe(float(value))
+
     # -- readers ---------------------------------------------------------
     def get_counter(self, name: str) -> int:
         with self._lock:
@@ -49,42 +170,65 @@ class Registry:
         with self._lock:
             return self._gauges.get(name, default)
 
+    def get_histogram(self, name: str) -> Optional[Dict[str, Any]]:
+        """Plain-dict view of one histogram
+        (``{"buckets", "counts", "sum", "count"}``) or None."""
+        with self._lock:
+            h = self._histograms.get(name)
+            return h.to_dict() if h is not None else None
+
     def snapshot(self) -> Dict[str, Any]:
-        """Plain-dict view: ``{"counters": .., "gauges": .., "phase_seconds"
-        : ..}``.  Phase timers come from ``utils/timetag`` (empty unless
-        LIGHTGBM_TPU_TIMETAG is on — the serializing measurement mode)."""
+        """Plain-dict view: ``{"counters": .., "gauges": ..,
+        "histograms": .., "phase_seconds": ..}``.  Phase timers come from
+        ``utils/timetag`` (empty unless LIGHTGBM_TPU_TIMETAG is on — the
+        serializing measurement mode)."""
         from ..utils import timetag
         with self._lock:
             return {
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
+                "histograms": {k: h.to_dict()
+                               for k, h in self._histograms.items()},
                 "phase_seconds": timetag.get_timings(),
             }
 
     # -- lifecycle -------------------------------------------------------
     def merge(self, snap: Mapping[str, Any]) -> None:
-        """Fold another registry's ``snapshot()`` in: counters add, gauges
-        last-write-wins (the incoming snapshot is 'newer')."""
+        """Fold another registry's ``snapshot()`` in: counters and
+        histogram bucket counts add, gauges last-write-wins (the incoming
+        snapshot is 'newer').  Used to fold fold-worker / per-host
+        accounts into one — after a multihost run every rank's scrapeable
+        registry can be merged into rank 0's view."""
         with self._lock:
             for name, v in dict(snap.get("counters", {})).items():
                 self._counters[name] += int(v)
             self._gauges.update(dict(snap.get("gauges", {})))
+            for name, hd in dict(snap.get("histograms", {})).items():
+                h = self._histograms.get(name)
+                if h is None:
+                    self._histograms[name] = _Hist.from_dict(hd)
+                else:
+                    h.fold(hd)
 
     def restore(self, snap: Mapping[str, Any]) -> None:
         """Overwrite this registry's values with a snapshot's (counters
-        AND gauges set, not added).  Crash-safe resume uses this so a
-        fresh process continues the interrupted run's cumulative account
-        (lightgbm_tpu/snapshot.py) — unlike ``merge``, which folds a
-        concurrent worker's snapshot INTO a live account."""
+        AND gauges set, not added; histograms replaced bit-exactly).
+        Crash-safe resume uses this so a fresh process continues the
+        interrupted run's cumulative account (lightgbm_tpu/snapshot.py)
+        — unlike ``merge``, which folds a concurrent worker's snapshot
+        INTO a live account."""
         with self._lock:
             for name, v in dict(snap.get("counters", {})).items():
                 self._counters[name] = int(v)
             self._gauges.update(dict(snap.get("gauges", {})))
+            for name, hd in dict(snap.get("histograms", {})).items():
+                self._histograms[name] = _Hist.from_dict(hd)
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
+            self._histograms.clear()
 
 
 REGISTRY = Registry()
@@ -100,12 +244,21 @@ def set_gauge(name: str, value: Any) -> None:
     REGISTRY.set_gauge(name, value)
 
 
+def observe(name: str, value: float,
+            buckets: Optional[Sequence[float]] = None) -> None:
+    REGISTRY.observe(name, value, buckets)
+
+
 def get_counter(name: str) -> int:
     return REGISTRY.get_counter(name)
 
 
 def get_gauge(name: str, default: Any = None) -> Any:
     return REGISTRY.get_gauge(name, default)
+
+
+def get_histogram(name: str) -> Optional[Dict[str, Any]]:
+    return REGISTRY.get_histogram(name)
 
 
 def snapshot() -> Dict[str, Any]:

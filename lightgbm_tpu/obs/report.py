@@ -1,0 +1,251 @@
+"""Offline run report over per-iteration JSONL event streams.
+
+``python -m lightgbm_tpu obs-report run.jsonl [more.jsonl ...]
+[--format=json|table] [--top=5]`` summarizes what a training run
+actually did, from the ``--events-file`` stream alone — no repo, no
+model file, no live process:
+
+- per-phase wall-time breakdown (the TIMETAG deltas each record
+  carries, summed; empty when the run didn't serialize),
+- total/committed iteration counts and total honest wall time,
+- the slowest-k iterations (where the stalls were),
+- NaN-containment and saturation incidents recorded by the
+  fault-tolerance layer (``nan_poisoned`` / ``saturated`` /
+  ``discarded`` fields, docs/FAULT_TOLERANCE.md),
+- collective-traffic totals (cumulative bytes/calls of the distributed
+  learner's collectives),
+- eval-metric trajectory per dataset/metric: first, best, last.
+
+Multiple files concatenate (multihost runs write one stream per rank;
+fold workers one per fold) — per-file iteration counts are reported so
+overlapping indices are visible rather than silently summed.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+from .events import read_events
+
+
+def _merge_by_iter(evs: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Collapse multiple records sharing one iteration index into one,
+    with the recorder's own merge semantics (dict fields key-wise,
+    scalars last-write-wins).  The commit-on-advance stream can emit a
+    late producer's fields as a second record for an already-committed
+    index (e.g. a pipelined tree shape landing after a NaN-poisoned
+    round forced an early commit) — per iteration they are ONE event."""
+    merged: Dict[int, Dict[str, Any]] = {}
+    order: List[int] = []
+    for e in evs:
+        it = int(e.get("iter", -1))
+        rec = merged.get(it)
+        if rec is None:
+            merged[it] = rec = {}
+            order.append(it)
+        for k, v in e.items():
+            if isinstance(v, dict) and isinstance(rec.get(k), dict):
+                rec[k].update(v)
+            else:
+                rec[k] = dict(v) if isinstance(v, dict) else v
+    return [merged[it] for it in order]
+
+
+def summarize(paths: Sequence[str], top_k: int = 5) -> Dict[str, Any]:
+    """Aggregate one or more event files into a report dict (the
+    ``--format=json`` payload; ``render_table`` prints the same dict).
+    Records are merged per iteration index WITHIN each file (ranks/folds
+    in separate files stay separate events)."""
+    events: List[Dict[str, Any]] = []
+    per_file: Dict[str, int] = {}
+    comm_bytes = 0
+    comm_calls = 0
+    for p in paths:
+        evs = read_events(p)
+        per_file[str(p)] = len(evs)
+        merged = _merge_by_iter(evs)
+        events.extend(merged)
+        # the comm counters are CUMULATIVE within one stream, and each
+        # file (rank/fold) is an independent account: take the max per
+        # file, then sum across files — max over the concatenation would
+        # report one worker's traffic as the whole run's
+        comm_bytes += max((int(e.get("comm_bytes_cum", 0) or 0)
+                           for e in merged), default=0)
+        comm_calls += max((int(e.get("comm_calls_cum", 0) or 0)
+                           for e in merged), default=0)
+
+    phases: Dict[str, float] = {}
+    wall_total = 0.0
+    timed: List[Dict[str, Any]] = []
+    nan_incidents: List[Dict[str, Any]] = []
+    saturated: List[int] = []
+    discarded: List[int] = []
+    eval_traj: Dict[str, Dict[str, List]] = {}
+    committed = 0
+
+    for e in events:
+        it = int(e.get("iter", -1))
+        if "wall_s" in e:
+            wall_total += float(e["wall_s"])
+            timed.append({"iter": it, "wall_s": float(e["wall_s"])})
+        for k, v in (e.get("phases") or {}).items():
+            phases[k] = phases.get(k, 0.0) + float(v)
+        if e.get("nan_poisoned"):
+            nan_incidents.append({"iter": it,
+                                  "what": e["nan_poisoned"],
+                                  "policy": e.get("nan_policy")})
+        if e.get("saturated"):
+            saturated.append(it)
+        if e.get("discarded"):
+            discarded.append(it)
+        if not e.get("saturated") and not e.get("discarded"):
+            committed += 1
+        for ds, metrics in (e.get("eval") or {}).items():
+            for name, v in (metrics or {}).items():
+                if v is None:
+                    continue
+                eval_traj.setdefault(ds, {}).setdefault(name, []).append(
+                    (it, float(v)))
+
+    timed.sort(key=lambda d: -d["wall_s"])
+    eval_summary: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    for ds, metrics in eval_traj.items():
+        eval_summary[ds] = {}
+        for name, series in metrics.items():
+            values = [v for _, v in series]
+            # direction-agnostic extremes: report both, the reader knows
+            # which way the metric improves
+            mn_i, mn = min(series, key=lambda t: t[1])
+            mx_i, mx = max(series, key=lambda t: t[1])
+            eval_summary[ds][name] = {
+                "first": values[0], "last": values[-1],
+                "min": mn, "min_iter": mn_i,
+                "max": mx, "max_iter": mx_i,
+                "n": len(values),
+            }
+
+    return {
+        "files": per_file,
+        "events": len(events),
+        "iterations": committed,
+        "wall_s_total": round(wall_total, 6),
+        "phase_seconds": {k: round(v, 6)
+                          for k, v in sorted(phases.items())},
+        "slowest": timed[:max(int(top_k), 0)],
+        "incidents": {
+            "nan": nan_incidents,
+            "saturated_iters": saturated,
+            "discarded_iters": discarded,
+        },
+        "comm": {"bytes_cum": comm_bytes, "calls_cum": comm_calls},
+        "eval": eval_summary,
+    }
+
+
+def _fmt_bytes(n: int) -> str:
+    v = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if v < 1024.0 or unit == "TiB":
+            return f"{v:.1f}{unit}" if unit != "B" else f"{int(v)}B"
+        v /= 1024.0
+    return f"{n}B"
+
+
+def render_table(rep: Dict[str, Any]) -> str:
+    """Human-readable report (the ``--format=table`` default)."""
+    out: List[str] = []
+    out.append("== obs-report ==")
+    for path, n in rep["files"].items():
+        out.append(f"file: {path} ({n} events)")
+    out.append(f"iterations: {rep['iterations']} committed / "
+               f"{rep['events']} events, "
+               f"wall {rep['wall_s_total']:.3f}s")
+
+    if rep["phase_seconds"]:
+        out.append("-- per-phase wall time --")
+        total = sum(rep["phase_seconds"].values()) or 1.0
+        for name, v in sorted(rep["phase_seconds"].items(),
+                              key=lambda t: -t[1]):
+            out.append(f"  {name:<24} {v:>10.3f}s  {100 * v / total:5.1f}%")
+    else:
+        out.append("-- per-phase wall time: none recorded "
+                   "(run without LIGHTGBM_TPU_TIMETAG=1) --")
+
+    if rep["slowest"]:
+        out.append(f"-- slowest {len(rep['slowest'])} iterations --")
+        for d in rep["slowest"]:
+            out.append(f"  iter {d['iter']:>6}  {d['wall_s']:.4f}s")
+
+    inc = rep["incidents"]
+    n_inc = (len(inc["nan"]) + len(inc["saturated_iters"])
+             + len(inc["discarded_iters"]))
+    out.append(f"-- incidents: {n_inc} --")
+    for d in inc["nan"]:
+        out.append(f"  iter {d['iter']}: non-finite {d['what']} "
+                   f"(nan_policy={d['policy']})")
+    if inc["saturated_iters"]:
+        out.append(f"  saturated (no more splits): "
+                   f"{inc['saturated_iters']}")
+    if inc["discarded_iters"]:
+        out.append(f"  discarded (dispatched past saturation): "
+                   f"{inc['discarded_iters']}")
+
+    comm = rep["comm"]
+    out.append(f"-- collective traffic: {_fmt_bytes(comm['bytes_cum'])} "
+               f"over {comm['calls_cum']} calls --")
+
+    if rep["eval"]:
+        out.append("-- eval trajectory --")
+        for ds in sorted(rep["eval"]):
+            for name, s in sorted(rep["eval"][ds].items()):
+                out.append(
+                    f"  {ds}/{name}: first {s['first']:g} -> last "
+                    f"{s['last']:g}  (min {s['min']:g}@{s['min_iter']}, "
+                    f"max {s['max']:g}@{s['max_iter']}, {s['n']} points)")
+    return "\n".join(out)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI entry: ``python -m lightgbm_tpu obs-report <events.jsonl ...>
+    [--format=json|table] [--top=K]``."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    fmt = "table"
+    top_k = 5
+    paths: List[str] = []
+    for tok in argv:
+        if tok.startswith("--format="):
+            fmt = tok.split("=", 1)[1].strip().lower()
+        elif tok.startswith("--top="):
+            try:
+                top_k = int(tok.split("=", 1)[1])
+            except ValueError:
+                print(f"obs-report: bad --top value in {tok!r}",
+                      file=sys.stderr)
+                return 2
+        elif tok.startswith("-"):
+            print(f"obs-report: unknown flag {tok!r}", file=sys.stderr)
+            return 2
+        else:
+            paths.append(tok)
+    if not paths:
+        print("usage: python -m lightgbm_tpu obs-report <events.jsonl ...> "
+              "[--format=json|table] [--top=K]", file=sys.stderr)
+        return 2
+    if fmt not in ("json", "table"):
+        print(f"obs-report: unknown format {fmt!r} (json|table)",
+              file=sys.stderr)
+        return 2
+    try:
+        rep = summarize(paths, top_k=top_k)
+    except (OSError, ValueError) as exc:
+        # ValueError covers json.JSONDecodeError: a crashed run can leave
+        # a torn final line — report it as a one-liner, not a traceback
+        print(f"obs-report: {exc}", file=sys.stderr)
+        return 1
+    if fmt == "json":
+        print(json.dumps(rep, indent=2, sort_keys=True))
+    else:
+        print(render_table(rep))
+    return 0

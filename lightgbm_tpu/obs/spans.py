@@ -1,0 +1,91 @@
+"""Unified wall-time spans feeding per-phase histograms.
+
+``span(name)`` is the always-on timer the metrics pipeline is built on:
+it measures host wall clock between enter and exit and lands ONE
+histogram observe in the process registry under the series name
+``phases.span_series(name)`` (``GBDT::tree`` ->
+``phase_seconds_gbdt_tree``).  Unlike ``utils/timetag.scope`` it never
+blocks on device values by default, so it can stay on in production —
+for async dispatches it honestly measures dispatch time, and the device
+side remains the trace capture's job.  The two instruments are unified:
+
+- when LIGHTGBM_TPU_TIMETAG is enabled, a span ALSO feeds the timetag
+  accumulator for ``name`` (one account, two sinks) and honors
+  ``sync(x)`` requests exactly like ``timetag.scope`` — the serializing
+  measurement mode attributes device time to the span's phase;
+- ``timetag.scope`` itself mirrors every enabled measurement into the
+  same histogram series, so non-migrated scope sites populate the
+  distribution too.
+
+``timed(name)`` wraps a function in a span — decorator sugar for
+hot-path-free helpers (model export, report generation).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from contextlib import contextmanager
+from typing import Callable, Optional, Sequence
+
+from . import phases, registry
+
+
+# span names are a small fixed set (the phase taxonomy); memoize the
+# name -> series string math so a span costs perf_counter + one observe
+_series_cache: dict = {}
+
+
+def _series(name: str) -> str:
+    s = _series_cache.get(name)
+    if s is None:
+        s = _series_cache[name] = phases.span_series(name)
+    return s
+
+
+class _SpanHandle:
+    """Yielded by ``span``: ``sync(x)`` registers device values to block
+    on before the clock stops — honored only under the serializing
+    TIMETAG mode, so production spans never force a host sync."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = None
+
+    def sync(self, value) -> None:
+        self.value = value
+
+
+@contextmanager
+def span(name: str, buckets: Optional[Sequence[float]] = None,
+         reg: Optional[registry.Registry] = None):
+    """Time this block into the ``span_series(name)`` wall-time
+    histogram (and the timetag accumulator when that mode is on)."""
+    from ..utils import timetag
+    r = reg if reg is not None else registry.REGISTRY
+    handle = _SpanHandle()
+    serialize = timetag.ENABLED
+    t0 = time.perf_counter()
+    try:
+        yield handle
+    finally:
+        if serialize and handle.value is not None:
+            import jax
+            jax.block_until_ready(handle.value)
+        dt = time.perf_counter() - t0
+        r.observe(_series(name), dt, buckets)
+        if serialize:
+            timetag.add(name, dt)
+
+
+def timed(name: str, buckets: Optional[Sequence[float]] = None) -> Callable:
+    """Decorator form: ``@obs.timed("Report::render")`` times every call
+    of the wrapped function into the phase histogram."""
+    def deco(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with span(name, buckets):
+                return fn(*args, **kwargs)
+        return wrapper
+    return deco

@@ -76,6 +76,27 @@ def traffic_totals(traffic):
             sum(v["bytes"] for v in traffic.values()))
 
 
+def observe_traffic(traffic, trees: int = 1) -> None:
+    """Feed ``trees`` tree growths' static collective account into the
+    metrics pipeline (obs/): one ``comm_bytes_<kind>`` histogram sample
+    per tree per collective kind (the per-tree payload that kind moved),
+    plus the aggregate ``comm_bytes`` series.  Host-side arithmetic on
+    the already-static account — the jitted path stays untouched, which
+    is the whole design of the traffic model (module header).  Merged
+    across hosts via ``registry.merge``, the per-rank distributions are
+    what makes stragglers and asymmetric meshes visible."""
+    if not traffic or trees <= 0:
+        return
+    from .. import obs
+    total = sum(v["bytes"] for v in traffic.values())
+    for _ in range(trees):
+        for kind, v in traffic.items():
+            obs.observe(f"comm_bytes_{kind}", float(v["bytes"]),
+                        buckets=obs.DEFAULT_BYTE_BUCKETS)
+        obs.observe("comm_bytes", float(total),
+                    buckets=obs.DEFAULT_BYTE_BUCKETS)
+
+
 def _allgather_combine(split: BestSplit, axis_name: str,
                        num_shards: int) -> BestSplit:
     """Allreduce(SplitInfo::MaxReducer): tiny all_gather + tournament."""

@@ -133,6 +133,9 @@ def globalize_grow_fn(grow_fn, mesh):
             np.asarray(a), mesh, PartitionSpec())
 
     def wrapped(*args):
+        import time as _time
+        from .. import obs
+        t0 = _time.perf_counter()
         glob = []
         for i, a in enumerate(args):
             if i < 3:
@@ -152,6 +155,13 @@ def globalize_grow_fn(grow_fn, mesh):
             multihost_utils.process_allgather(leaf_id, tiled=True))
         delta = jax.numpy.asarray(
             multihost_utils.process_allgather(delta, tiled=True))
+        # per-tree wall time of the cross-process growth, including its
+        # collectives — the process_allgather above synchronized, so this
+        # is a real (not dispatch-only) duration.  Every rank records its
+        # own comm_seconds histogram; scraped per rank (metrics_server's
+        # rank label) or folded with registry.merge, the distribution is
+        # the straggler detector.
+        obs.observe("comm_seconds", _time.perf_counter() - t0)
         return tree, leaf_id, delta
 
     return wrapped

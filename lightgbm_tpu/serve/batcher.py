@@ -154,12 +154,14 @@ class MicroBatcher:
     streams them in largest-bucket chunks).
 
     obs account: ``serve_requests``/``serve_rows`` at submit,
-    ``serve_batches``/``serve_batch_rows`` per device batch, and
-    ``serve_latency_p50_ms``/``serve_latency_p99_ms`` gauges over a ring
-    of recent request latencies (enqueue -> result ready).
+    ``serve_batches``/``serve_batch_rows`` per device batch, one sample
+    per request into the ``serve_latency_seconds`` histogram
+    (enqueue -> result ready; scrapeable as a full distribution at
+    ``GET /metrics``), and the historical
+    ``serve_latency_p50_ms``/``serve_latency_p99_ms`` gauges kept as
+    values DERIVED from that histogram (bucket interpolation — estimates
+    now, not exact order statistics over a ring).
     """
-
-    _LATENCY_RING = 2048
 
     def __init__(self, predict_fn: Callable[[np.ndarray], np.ndarray],
                  max_batch: int = 8192, max_delay_s: float = 0.005):
@@ -170,7 +172,6 @@ class MicroBatcher:
         self._cond = threading.Condition(self._lock)
         self._queue: List[_Pending] = []
         self._closed = False
-        self._latencies: List[float] = []
         self._lat_seq = 0
         self._worker = threading.Thread(target=self._run,
                                         name="lgbt-serve-batcher",
@@ -244,7 +245,6 @@ class MicroBatcher:
             return batch
 
     def _run(self) -> None:
-        from ..utils import timetag
         while True:
             batch = self._take_batch()
             if batch is None:
@@ -252,7 +252,7 @@ class MicroBatcher:
             if not batch:          # spurious wakeup at shutdown
                 continue
             try:
-                with timetag.scope("Serve::batch"):
+                with obs.span("Serve::batch"):
                     rows = (batch[0].rows if len(batch) == 1 else
                             np.concatenate([r.rows for r in batch], axis=0))
                     out = self.predict_fn(rows)
@@ -273,23 +273,24 @@ class MicroBatcher:
     _GAUGE_EVERY = 32
 
     def _note_latency(self, ms: float) -> None:
-        # the percentile refresh copies the ring and sorts it twice —
-        # too much bookkeeping to pay per request under load, so gauges
-        # update on the first request and every _GAUGE_EVERY after
+        # the real record is the histogram: one lock'd bucket update per
+        # request, the full distribution scrapeable at /metrics.  The
+        # historical p50/p99 gauges survive as values DERIVED from it
+        # (PromQL-style bucket interpolation), refreshed on the first
+        # request and every _GAUGE_EVERY after — the quantile walk is
+        # too much bookkeeping to pay per request under load.
+        obs.observe("serve_latency_seconds", ms / 1000.0)
         with self._lock:
-            self._latencies.append(ms)
-            if len(self._latencies) > self._LATENCY_RING:
-                del self._latencies[:len(self._latencies)
-                                    - self._LATENCY_RING]
             self._lat_seq += 1
             if self._lat_seq % self._GAUGE_EVERY != 1 \
                     and self._GAUGE_EVERY > 1:
                 return
-            lat = np.asarray(self._latencies)
-        obs.set_gauge("serve_latency_p50_ms",
-                      round(float(np.percentile(lat, 50)), 3))
-        obs.set_gauge("serve_latency_p99_ms",
-                      round(float(np.percentile(lat, 99)), 3))
+        hist = obs.get_histogram("serve_latency_seconds")
+        p50 = obs.histogram_quantile(hist, 0.50)
+        p99 = obs.histogram_quantile(hist, 0.99)
+        if p50 is not None and p99 is not None:
+            obs.set_gauge("serve_latency_p50_ms", round(p50 * 1000.0, 3))
+            obs.set_gauge("serve_latency_p99_ms", round(p99 * 1000.0, 3))
 
 
 def _slice_rows(out, off: int, n: int):

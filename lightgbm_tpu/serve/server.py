@@ -15,8 +15,14 @@ Protocol (JSON in/out; CSV/TSV accepted for rows):
   ``{"predictions": [...], "num_rows": n}`` — one float per row, or one
   list of ``num_class`` floats per row for multiclass.
 - ``GET /healthz``: liveness + frozen-forest shape info.
-- ``GET /stats``: the obs registry's serve/predict counters and latency
-  gauges (``serve_latency_p50_ms`` / ``serve_latency_p99_ms``).
+- ``GET /stats``: the FULL obs registry snapshot as JSON — every
+  counter, every numeric gauge, and per-histogram summaries
+  (count/sum/p50/p99); new metric names appear here automatically
+  instead of drifting out of a hand-picked key list.
+- ``GET /metrics``: the same registry in Prometheus text exposition
+  0.0.4 (``lightgbm_tpu_`` namespace, obs/prom.py) for standard
+  scrapers — including the ``serve_latency_seconds`` histogram the
+  micro-batcher feeds per request.
 
 Shutdown is graceful: SIGINT/SIGTERM (or ``PredictServer.stop()``)
 stops accepting, drains queued requests through the batcher, then joins
@@ -72,6 +78,34 @@ def _json_predictions(raw: np.ndarray, out: np.ndarray,
     return [[float(v) for v in col] for col in scores.T]
 
 
+def registry_stats() -> dict:
+    """JSON-ready view of the full obs registry: every counter and
+    gauge verbatim (non-JSON gauge payloads stringified), histograms
+    summarized as count/sum/mean plus interpolated p50/p99 — the
+    ``/stats`` contract, pinned by tests so it can never drift from new
+    metric names."""
+    from ..obs import histogram_quantile
+    snap = obs.snapshot()
+    gauges = {}
+    for k, v in snap["gauges"].items():
+        gauges[k] = v if isinstance(v, (int, float, str, bool,
+                                        type(None))) else str(v)
+    hists = {}
+    for name, h in snap["histograms"].items():
+        p50 = histogram_quantile(h, 0.50)
+        p99 = histogram_quantile(h, 0.99)
+        hists[name] = {
+            "count": h["count"],
+            "sum": round(float(h["sum"]), 9),
+            "mean": (round(float(h["sum"]) / h["count"], 9)
+                     if h["count"] else None),
+            "p50": round(p50, 9) if p50 is not None else None,
+            "p99": round(p99, 9) if p99 is not None else None,
+        }
+    return {"counters": snap["counters"], "gauges": gauges,
+            "histograms": hists}
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "lightgbm-tpu-serve/1.0"
     protocol_version = "HTTP/1.1"
@@ -93,14 +127,19 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             self._reply(200, {"status": "ok", **srv.forest.info()})
         elif self.path == "/stats":
-            snap = obs.snapshot()
-            self._reply(200, {
-                "counters": {k: v for k, v in snap["counters"].items()
-                             if k.startswith(("serve_", "predict_forest",
-                                              "forest_"))},
-                "gauges": {k: v for k, v in snap["gauges"].items()
-                           if k.startswith(("serve_", "forest_"))},
-            })
+            # the WHOLE registry, not a hand-picked key list: new metric
+            # names (histogram series included) surface here without this
+            # handler ever learning about them
+            self._reply(200, registry_stats())
+        elif self.path == "/metrics":
+            from ..obs import prom
+            from ..obs.metrics_server import rank_labels
+            body = prom.render(labels=rank_labels()).encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", prom.CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 

@@ -165,6 +165,7 @@ def capture_booster_state(booster, rounds_done: int,
     iteration first — ``GBDT.snapshot_state`` does that)."""
     from . import obs
     gb = booster._booster
+    obs_snap = obs.snapshot()
     return {
         "version": SNAPSHOT_VERSION,
         "rounds_done": int(rounds_done),
@@ -172,7 +173,13 @@ def capture_booster_state(booster, rounds_done: int,
         "evals_result": (copy.deepcopy(evals_result)
                          if evals_result else None),
         "best_iteration": int(booster.best_iteration),
-        "obs_counters": obs.snapshot()["counters"],
+        # legacy key kept so old readers of new snapshots still see the
+        # counter account; obs_state is the full registry (counters +
+        # gauges + histograms) restored bit-exactly on resume
+        "obs_counters": obs_snap["counters"],
+        "obs_state": {"counters": obs_snap["counters"],
+                      "gauges": obs_snap["gauges"],
+                      "histograms": obs_snap["histograms"]},
     }
 
 
@@ -184,9 +191,13 @@ def restore_booster_state(booster, state: Dict[str, Any]) -> int:
     from . import obs
     booster._booster.restore_state(state["booster"])
     booster.best_iteration = int(state.get("best_iteration", -1))
-    counters = state.get("obs_counters")
-    if counters:
-        obs.REGISTRY.restore({"counters": counters})
+    obs_state = state.get("obs_state")
+    if obs_state:
+        # full registry resume: counters, gauges, and histogram bucket
+        # state come back bit-exactly (pickle round-trips the float sum)
+        obs.REGISTRY.restore(obs_state)
+    elif state.get("obs_counters"):
+        obs.REGISTRY.restore({"counters": state["obs_counters"]})
     return int(state.get("rounds_done", 0))
 
 

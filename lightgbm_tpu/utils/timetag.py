@@ -40,6 +40,14 @@ _acc: Dict[str, float] = defaultdict(float)
 _cnt: Dict[str, int] = defaultdict(int)
 
 
+def add(name: str, seconds: float) -> None:
+    """Accumulate an externally measured duration under ``name`` —
+    ``obs.span`` feeds its measurements here when TIMETAG is enabled so
+    the two instruments share one account."""
+    _acc[name] += seconds
+    _cnt[name] += 1
+
+
 class _Sync:
     """Collects device values to block on when the scope closes."""
 
@@ -83,8 +91,15 @@ def scope(name: str):
         if s.value is not None:
             import jax
             jax.block_until_ready(s.value)
-        _acc[name] += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        _acc[name] += dt
         _cnt[name] += 1
+        # mirror into the per-phase wall-time histogram (obs/spans.py):
+        # under the serializing TIMETAG mode, scope sites populate the
+        # same distribution series that obs.span feeds, so the phase
+        # account has one metrics namespace regardless of instrument
+        from ..obs import registry, spans
+        registry.observe(spans._series(name), dt)
 
 
 def get_timings() -> Dict[str, float]:

@@ -19,6 +19,55 @@ def test_phase_taxonomies_in_sync():
     assert _load_lint().check() == []
 
 
+def test_lint_recognizes_obs_span_sites():
+    """obs.span("X") counts as a host-phase user alongside
+    timetag.scope("X") — the always-on span API feeds the same account."""
+    lint = _load_lint()
+    m = lint.SCOPE_RE.search('with obs.span("GBDT::iteration"):')
+    assert m and m.group(1) == "GBDT::iteration"
+    m = lint.SCOPE_RE.search('with timetag.scope("GBDT::tree") as tt:')
+    assert m and m.group(1) == "GBDT::tree"
+
+
+def test_every_phase_resolves_to_unique_span_series():
+    """Check 4: the phase taxonomy maps 1:1 onto valid histogram series
+    names, so the metrics namespace cannot diverge from phases.py."""
+    import pathlib
+    import importlib.util
+    path = (pathlib.Path(__file__).resolve().parent.parent
+            / "lightgbm_tpu" / "obs" / "phases.py")
+    spec = importlib.util.spec_from_file_location("phases_standalone", path)
+    phases = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(phases)          # no package/jax import
+    lint = _load_lint()
+    seen = {}
+    for name in phases.HOST_PHASES | phases.DEVICE_PHASES:
+        series = phases.span_series(name)
+        assert lint.SERIES_RE.match(series), (name, series)
+        assert series not in seen, (name, seen[series])
+        seen[series] = name
+
+
+def test_lint_catches_span_series_collision(tmp_path, monkeypatch):
+    """Two phases aliasing onto one series name is a lint error."""
+    lint = _load_lint()
+    pkg = tmp_path / "lightgbm_tpu"
+    (pkg / "obs").mkdir(parents=True)
+    (pkg / "ops").mkdir()
+    real = (pathlib.Path(lint.__file__).resolve().parent.parent
+            / "lightgbm_tpu" / "obs" / "phases.py")
+    # "Gbdt.tree" sanitizes to the same series as "GBDT::tree"
+    (pkg / "obs" / "phases.py").write_text(
+        real.read_text()
+        + '\nHOST_PHASES = frozenset(HOST_PHASES | {"Gbdt.tree"})\n')
+    (pkg / "ops" / "grow.py").write_text("")
+    (pkg / "ops" / "ordered_grow.py").write_text("")
+    monkeypatch.setattr(lint, "ROOT", tmp_path)
+    monkeypatch.setattr(lint, "PKG", pkg)
+    errors = lint.check()
+    assert any("collide" in e and "Gbdt.tree" in e for e in errors)
+
+
 def test_lint_catches_undeclared_scope(tmp_path, monkeypatch):
     """Sanity: a scope name outside the taxonomy is reported."""
     lint = _load_lint()

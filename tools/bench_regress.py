@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Benchmark regression gate: compare a fresh BENCH_*.json against a
+named baseline and fail loudly on a throughput regression.
+
+``bench.py`` prints one JSON line per run ({"metric", "value", "unit",
+...}); the driver archives them as ``BENCH_rNN.json`` (either the bare
+result object or the driver envelope whose ``tail``/``parsed`` fields
+hold it).  This tool makes those files actionable:
+
+    python tools/bench_regress.py --baseline BENCH_r05.json \
+        --candidate /tmp/bench_new.json --threshold 5
+
+exits 0 when the candidate's ``value`` is within ``--threshold`` percent
+below the baseline (higher is always better here — both bench modes
+report rates), 1 on a regression, 2 on unreadable/mismatched inputs.
+The one-line JSON verdict on stdout carries both values and the delta so
+a CI log shows the numbers, not just the exit code.  Intended CI shape
+once a TPU runner exists (docs/OBSERVABILITY.md §Benchmark regression
+gate):
+
+    python bench.py > /tmp/bench_new.json
+    python tools/bench_regress.py --baseline BENCH_r05.json \
+        --candidate /tmp/bench_new.json --threshold 10
+
+Mind the variance notes in docs/BENCH_NOTES_r03.md: the shared device
+measured 5.9-7.5 it/s for identical code across a day, so gate with a
+threshold wider than the observed window spread (the JSON's ``spread``
+tail comment) or on a quiet runner.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Optional
+
+
+def extract_result(path: str) -> Dict[str, Any]:
+    """Load a bench result from either a bare bench.py JSON line or a
+    driver envelope (``parsed`` field, or the last JSON object line of a
+    ``tail`` transcript)."""
+    with open(path) as fh:
+        text = fh.read()
+    obj = json.loads(text)
+    if "value" in obj and "metric" in obj:
+        return obj
+    if isinstance(obj.get("parsed"), dict) and "value" in obj["parsed"]:
+        return obj["parsed"]
+    tail = obj.get("tail", "")
+    result: Optional[Dict[str, Any]] = None
+    for line in str(tail).splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                cand = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "value" in cand and "metric" in cand:
+                result = cand
+    if result is None:
+        raise ValueError(f"{path}: no bench result object found")
+    return result
+
+
+def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
+            threshold_pct: float) -> Dict[str, Any]:
+    """Verdict dict; ``ok`` is False when the candidate regressed more
+    than ``threshold_pct`` percent below the baseline value."""
+    if baseline.get("metric") != candidate.get("metric"):
+        raise ValueError(
+            f"metric mismatch: baseline {baseline.get('metric')!r} vs "
+            f"candidate {candidate.get('metric')!r} — comparing different "
+            f"workloads is not a regression check")
+    base = float(baseline["value"])
+    cand = float(candidate["value"])
+    if base <= 0:
+        raise ValueError(f"baseline value {base} is not a positive rate")
+    delta_pct = (cand - base) / base * 100.0
+    return {
+        "metric": baseline.get("metric"),
+        "unit": baseline.get("unit"),
+        "baseline": base,
+        "candidate": cand,
+        "delta_pct": round(delta_pct, 3),
+        "threshold_pct": float(threshold_pct),
+        "ok": delta_pct >= -float(threshold_pct),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fail on >threshold%% bench throughput regression")
+    ap.add_argument("--baseline", required=True,
+                    help="baseline BENCH_*.json (bare result or driver "
+                         "envelope)")
+    ap.add_argument("--candidate", required=True,
+                    help="fresh bench.py output JSON to check")
+    ap.add_argument("--threshold", type=float, default=5.0,
+                    help="allowed regression in percent (default 5)")
+    args = ap.parse_args(argv)
+    try:
+        verdict = compare(extract_result(args.baseline),
+                          extract_result(args.candidate), args.threshold)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError) as exc:
+        print(f"bench_regress: {exc}", file=sys.stderr)
+        return 2
+    print(json.dumps(verdict))
+    if not verdict["ok"]:
+        print(f"bench_regress: REGRESSION {verdict['delta_pct']:+.2f}% "
+              f"(threshold -{args.threshold:g}%) on {verdict['metric']}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

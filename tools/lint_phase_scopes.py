@@ -17,6 +17,15 @@ attributed back to the host account) if both match the declarations in
    every JITTED_HOST_PHASE is covered by at least one device phase —
    a rename on either side fails here instead of silently splitting the
    accounts.
+4. every phase named in phases.py (host AND device) resolves through
+   ``phases.span_series`` to a valid, UNIQUE Prometheus-safe histogram
+   series name — the span/metrics namespace (obs/spans.py, obs/prom.py)
+   and the phase taxonomy cannot diverge, and no two phases can silently
+   alias onto one series.
+
+``obs.span("X")`` sites count as host-phase users alongside
+``timetag.scope("X")`` — the span API is the always-on successor and
+feeds the same phase account (obs/spans.py).
 
 Runs standalone (``python tools/lint_phase_scopes.py``) and as a tier-1
 test (tests/test_phase_lint.py).  phases.py is loaded by file path so
@@ -34,8 +43,10 @@ from typing import Dict, List
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 PKG = ROOT / "lightgbm_tpu"
 
-SCOPE_RE = re.compile(r"timetag\.scope\(\s*[\"']([^\"']+)[\"']")
+SCOPE_RE = re.compile(
+    r"(?:timetag\.scope|obs\.span|spans\.span)\(\s*[\"']([^\"']+)[\"']")
 NAMED_RE = re.compile(r"jax\.named_scope\(\s*[\"']([^\"']+)[\"']")
+SERIES_RE = re.compile(r"^phase_seconds_[a-z_][a-z0-9_]*$")
 
 # the jitted paths carrying the device taxonomy: the growers plus the
 # compiled-forest inference program (serve/forest.py)
@@ -106,6 +117,25 @@ def check() -> List[str]:
         errors.append(
             f"jitted host phase {name!r} has no device phase mapped onto "
             f"it — traces inside it would be unattributable")
+
+    # -- 4: phase taxonomy <-> metrics namespace (obs/spans.py) ---------
+    span_series = getattr(phases, "span_series", None)
+    if span_series is None:
+        errors.append("obs/phases.py no longer defines span_series() — "
+                      "the span/metrics namespace is unmapped")
+        return errors
+    seen: Dict[str, str] = {}
+    for name in sorted(phases.HOST_PHASES | phases.DEVICE_PHASES):
+        series = span_series(name)
+        if not SERIES_RE.match(series):
+            errors.append(
+                f"span_series({name!r}) = {series!r} is not a valid "
+                f"phase histogram series name ({SERIES_RE.pattern})")
+        if series in seen:
+            errors.append(
+                f"phases {seen[series]!r} and {name!r} collide onto the "
+                f"same span series {series!r}")
+        seen[series] = name
     return errors
 
 
