@@ -121,6 +121,7 @@ def predict_main() -> None:
             "p99_ms": round(float(np.percentile(lat, 99)), 3),
         }
     top = batches[str(max(sizes))]
+    from lightgbm_tpu.obs import compile_ledger
     print(json.dumps({
         "metric": f"serve_rows_per_sec_higgslike_{trees}trees_"
                   "63leaves_255bins_binary",
@@ -128,6 +129,8 @@ def predict_main() -> None:
         "unit": "rows/sec",
         "vs_baseline": None,
         "batches": batches,
+        "warmup_s": round(t_warm, 3),
+        "compile_events": compile_ledger.summary(5),
     }))
     c = obs.snapshot()["counters"]
     print(f"# device={jax.devices()[0].platform} train_s={t_train:.1f} "
@@ -190,12 +193,20 @@ def main() -> None:
     base = CPU_REF_ITERS_PER_SEC.get(num_data)
     vs = (iters_per_sec / base) if base else None
 
+    # structured warmup/compile block: first-class JSON keys (not buried
+    # in the tail comment) so tools/bench_regress.py can gate warmup
+    # regressions (--warmup-threshold), and the compile ledger says WHICH
+    # programs the warmup tax went to (lightgbm_tpu/obs/compile_ledger.py)
+    from lightgbm_tpu.obs import compile_ledger
     print(json.dumps({
         "metric": f"boosting_iters_per_sec_higgslike{num_data // 1000}k_"
                   "63leaves_255bins_binary",
         "value": round(iters_per_sec, 4),
         "unit": "iters/sec",
         "vs_baseline": round(vs, 4) if vs is not None else None,
+        "warmup_s": round(t_warm, 3),
+        "spread": [round(min(rates), 4), round(max(rates), 4)],
+        "compile_events": compile_ledger.summary(5),
     }))
     # trailing comment line only — the JSON line above is the contract.
     # LIGHTGBM_TPU_TIMETAG=1 folds the serializing per-phase breakdown in

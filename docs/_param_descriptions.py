@@ -37,6 +37,17 @@ DESC = {
                     "docs/OBSERVABILITY.md)",
     "metrics_host": "bind address of the training /metrics listener "
                     "(default 127.0.0.1)",
+    "compile_ledger_file": "append-only JSONL of every XLA compilation "
+                           "(program, abstract shapes, seconds); "
+                           "LIGHTGBM_TPU_COMPILE_LEDGER env wins "
+                           "(docs/OBSERVABILITY.md)",
+    "memwatch": "sample HBM watermark gauges (live/peak device bytes, "
+                "per phase) at span boundaries; off by default, "
+                "LIGHTGBM_TPU_MEMWATCH env wins",
+    "trace_events_file": "Chrome trace-event JSON export of the causal "
+                         "span tree (one trace per serve request / "
+                         "boosting round; load in Perfetto); "
+                         "LIGHTGBM_TPU_TRACE_EVENTS env wins",
     "use_two_round_loading": "stream the data file in two rounds instead of "
                              "materializing the full float matrix "
                              "(io/streaming.py)",

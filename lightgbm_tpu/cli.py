@@ -15,7 +15,12 @@ GNU-style flags normalize onto the same namespace (``--events-file=x``
 record per boosting iteration (phase timings, eval values, tree shape,
 cumulative collective bytes — lightgbm_tpu/obs/, docs/OBSERVABILITY.md);
 ``--trace-dir`` (or LIGHTGBM_TPU_TRACE_DIR) captures a device trace over
-a window of iterations.
+a window of iterations.  Deep observability (docs/OBSERVABILITY.md):
+``compile_ledger_file=`` writes an append-only JSONL of every XLA
+compile (program, shapes, seconds); ``trace_events_file=`` exports the
+causal span tree (one trace per boosting round / serve request) as
+Perfetto-loadable Chrome trace JSON; ``memwatch=true`` samples HBM
+watermark gauges at span boundaries.
 
 Fault tolerance (docs/FAULT_TOLERANCE.md): ``snapshot_dir=<dir>
 snapshot_freq=<K>`` (alias ``save_period``, reference CLI convention)
@@ -148,12 +153,16 @@ def main(argv=None) -> int:
         print("usage: python -m lightgbm_tpu config=<conf> [key=value ...] "
               "[--events-file=<jsonl>] [--trace-dir=<dir>] "
               "[metrics_port=<p>] "
+              "[compile_ledger_file=<jsonl>] [trace_events_file=<json>] "
+              "[memwatch=true] "
               "[snapshot_dir=<dir> snapshot_freq=<K>] "
               "[nan_policy=fail_fast|skip_tree]\n"
               "       python -m lightgbm_tpu serve input_model=<model> "
               "[serve_port=<p> serve_max_batch=<n> serve_max_delay_ms=<ms>]\n"
               "       python -m lightgbm_tpu obs-report <events.jsonl ...> "
-              "[--format=json|table] [--top=K]")
+              "[--format=json|table] [--top=K] [--compile=<ledger.jsonl>]\n"
+              "       python -m lightgbm_tpu obs-report --traces "
+              "<trace_events.json ...>")
         return 1
     # offline run report over --events-file streams: positional file
     # arguments, so it routes before the key=value parser

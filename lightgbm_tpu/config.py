@@ -12,6 +12,8 @@ from __future__ import annotations
 import copy
 from typing import Any, Dict, List, Mapping, Optional
 
+from .utils import coerce_bool as _coerce_bool
+
 # ---------------------------------------------------------------------------
 # Alias table (reference config.h:322-416).  alias -> canonical name.
 # ---------------------------------------------------------------------------
@@ -212,6 +214,13 @@ _DEFAULTS: Dict[str, Any] = {
     "metrics_port": 0,         # training /metrics listener port (0 = off;
                                # LIGHTGBM_TPU_METRICS_PORT env wins)
     "metrics_host": "127.0.0.1",  # bind address for the metrics listener
+    "compile_ledger_file": "",  # append-only JSONL of every XLA compile
+                                # (LIGHTGBM_TPU_COMPILE_LEDGER env wins)
+    "memwatch": False,          # HBM watermark gauges at span boundaries
+                                # (LIGHTGBM_TPU_MEMWATCH env wins)
+    "trace_events_file": "",    # Chrome trace-event JSON export of the
+                                # causal span tree (LIGHTGBM_TPU_TRACE_EVENTS
+                                # env wins; load in Perfetto)
 }
 
 _BOOL_KEYS = {k for k, v in _DEFAULTS.items() if isinstance(v, bool)}
@@ -271,12 +280,6 @@ def apply_aliases(params: Mapping[str, Any]) -> Dict[str, Any]:
     return out
 
 
-def _coerce_bool(value: Any) -> bool:
-    if isinstance(value, bool):
-        return value
-    if isinstance(value, (int, float)):
-        return bool(value)
-    return str(value).strip().lower() in ("true", "1", "yes", "y", "t", "+")
 
 
 def _coerce_list(value: Any, elem=str) -> List[Any]:

@@ -50,6 +50,15 @@ def train(params, train_set, num_boost_round=100,
     checksum and fall back to the previous one."""
     params = dict(params or {})
     events_file = events_file or params.get("events_file") or None
+    # -- deep observability (lightgbm_tpu/obs/, docs/OBSERVABILITY.md):
+    # compile ledger / HBM watermarks / causal trace export.  All off
+    # unless configured; the matching env vars win inside configure().
+    from .obs import compile_ledger as _compile_ledger
+    from .obs import memwatch as _memwatch
+    from .obs import tracing as _tracing
+    _compile_ledger.configure(params.get("compile_ledger_file") or None)
+    _memwatch.configure(params.get("memwatch"))
+    _tracing.TRACER.configure(params.get("trace_events_file") or None)
     # -- crash-safe snapshot/resume (lightgbm_tpu/snapshot.py) ----------
     snapshot_dir = str(params.get("snapshot_dir") or "") or None
     try:
@@ -265,6 +274,9 @@ def train(params, train_set, num_boost_round=100,
             booster._booster.set_event_recorder(None)
         if metrics_server is not None:
             metrics_server.stop()
+        # flush the causal span tree (one trace per boosting round) to
+        # the configured Chrome trace-event file
+        _tracing.TRACER.maybe_export()
     return booster
 
 

@@ -147,7 +147,7 @@ class _DeviceData:
 
             pad = _size_classes(dataset.num_data)[-1]
 
-            @jax.jit
+            @obs.instrumented_jit(program="pack_words")
             def _pack_padded(rm):
                 return tuple(jnp.pad(w, (0, pad))
                              for w in pack_u8_words(rm))
@@ -171,7 +171,7 @@ class _DeviceData:
         self.score = self.score.at[cls].add(delta)
 
 
-@jax.jit
+@obs.instrumented_jit(program="finite_guard")
 def _all_finite(*arrays):
     """One device scalar: every element of every array is finite.  The
     NaN/Inf containment guard (``nan_policy``) reads this per iteration;
@@ -183,7 +183,7 @@ def _all_finite(*arrays):
     return ok
 
 
-@functools.partial(jax.jit, static_argnames=("n", "bag_cnt"))
+@obs.instrumented_jit(program="bag_mask", static_argnames=("n", "bag_cnt"))
 def _device_bag_mask(key, n: int, bag_cnt: int):
     """EXACT-count sample without replacement (reference bag_data_cnt_).
 
@@ -283,8 +283,10 @@ class GBDT:
         self._bag_key = jax.random.PRNGKey(cfg.bagging_seed)
         self._feature_rng = np.random.RandomState(cfg.feature_fraction_seed)
         self._row_weight = jnp.ones(self.num_data, jnp.float32)
-        self._grad_fn = jax.jit(self.objective.gradients)
-        self._pack_fn = jax.jit(pack_tree_arrays)
+        self._grad_fn = obs.instrumented_jit(self.objective.gradients,
+                                             program="train_gradients")
+        self._pack_fn = obs.instrumented_jit(pack_tree_arrays,
+                                             program="pack_tree")
         self._grow_fn = self._make_grow_fn()
         # device-constant caches (avoid a host->device transfer per iter)
         self._full_feat_mask = jnp.ones(self.num_features, bool)
@@ -478,7 +480,8 @@ class GBDT:
                                          bool)
         # a fresh jit: the old one captured the previous dataset's labels
         # (objective.init state) as compile-time constants
-        self._grad_fn = jax.jit(self.objective.gradients)
+        self._grad_fn = obs.instrumented_jit(self.objective.gradients,
+                                             program="train_gradients")
         self._grow_fn = self._make_grow_fn()
         self._train_step = None
         for i, tree in enumerate(self._models):
@@ -599,7 +602,7 @@ class GBDT:
         # ungated path compiles the check away entirely.
         guard = self._nan_policy != "none"
 
-        @jax.jit
+        @obs.instrumented_jit(program="train_step")
         def step_fn(score, feat_masks, row_weight, lr):
             grad, hess = obj_grad(score)
             ok = (_all_finite(grad, hess) if guard else jnp.asarray(True))

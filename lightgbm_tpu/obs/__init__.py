@@ -34,9 +34,27 @@ one end-to-end number.  Pieces:
   the serve subsystem's HTTP front end.
 - ``report``: ``python -m lightgbm_tpu obs-report`` — offline summary
   of an ``--events-file`` stream (per-phase totals, slowest iterations,
-  NaN/saturation incidents, collective traffic, eval trajectory).
+  NaN/saturation incidents, collective traffic, eval trajectory), of a
+  compile ledger (``--compile=``), and of trace-event files
+  (``--traces``).
+- ``compile_ledger``: process-wide account of every XLA compilation —
+  program name, abstract input shapes, wall seconds — captured by
+  ``instrumented_jit`` at the repo's own jit entry points, feeding
+  ``compile_count``/``compile_seconds`` registry series and an
+  append-only ``compile_ledger.jsonl``
+  (``LIGHTGBM_TPU_COMPILE_LEDGER``/``compile_ledger_file``).
+- ``memwatch``: HBM watermark gauges (live/peak device bytes, per span
+  phase) sampled at span boundaries; off by default
+  (``memwatch``/``LIGHTGBM_TPU_MEMWATCH``).
+- ``tracing``: parent-linked span trees with trace IDs — one trace per
+  serve HTTP request (queue -> coalesced batch -> device predict, with
+  explicit many-to-one coalesce edges) and per boosting round — exported
+  as Perfetto-loadable Chrome trace-event JSON
+  (``trace_events_file``/``LIGHTGBM_TPU_TRACE_EVENTS``).
 """
 
+from .compile_ledger import (InstrumentedJit, abstract_shapes,  # noqa: F401
+                             instrumented_jit)
 from .events import SCHEMA_VERSION, EventRecorder, read_events  # noqa: F401
 from .phases import (DEVICE_PARENT, DEVICE_PHASES,  # noqa: F401
                      HOST_PHASES, JITTED_HOST_PHASES, span_series)
@@ -47,6 +65,31 @@ from .registry import (DEFAULT_BYTE_BUCKETS,  # noqa: F401
                        restore, set_gauge, snapshot)
 from .spans import span, timed  # noqa: F401
 from .trace import TraceCapture  # noqa: F401
+from .tracing import TRACER  # noqa: F401
+
+
+def trace_span(name, args=None, parent=None):
+    """Context manager: one causal-tracing span (no histogram observe —
+    use ``obs.span`` for timed phases).  No-op while the tracer is
+    disarmed."""
+    return TRACER.span(name, args=args, parent=parent)
+
+
+def trace_begin(name, parent=None, args=None):
+    """Open a tracing span to be ended by ``trace_end`` — possibly from
+    another thread (the batcher ends request queue spans from its
+    worker).  Returns None while the tracer is disarmed."""
+    return TRACER.begin(name, parent=parent, args=args)
+
+
+def trace_end(handle, args=None):
+    TRACER.end(handle, args=args)
+
+
+def trace_link(src, dst):
+    """Record a many-to-one coalesce edge ``src -> dst``."""
+    TRACER.link(src, dst)
+
 
 __all__ = [
     "REGISTRY", "Registry", "inc", "set_gauge", "observe", "get_counter",
@@ -56,5 +99,7 @@ __all__ = [
     "span", "timed", "span_series",
     "EventRecorder", "read_events", "SCHEMA_VERSION",
     "TraceCapture",
+    "instrumented_jit", "InstrumentedJit", "abstract_shapes",
+    "TRACER", "trace_span", "trace_begin", "trace_end", "trace_link",
     "HOST_PHASES", "DEVICE_PHASES", "DEVICE_PARENT", "JITTED_HOST_PHASES",
 ]

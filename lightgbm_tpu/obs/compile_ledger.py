@@ -1,0 +1,270 @@
+"""Process-wide account of every XLA compilation.
+
+BENCH_r02-r05 showed training throughput flat while warmup swung 34-321 s
+of XLA compiles — and nothing could say WHICH programs compiled, for
+which shapes, or how long each took.  This module is that account:
+
+- ``instrumented_jit(fn, program=...)`` wraps a function in ``jax.jit``
+  (or wraps an already-jitted callable) and detects each compilation the
+  same way ``serve/batcher.py``'s ``CountingJit`` always has — the jit's
+  executable-cache size grows exactly when a call shape-missed.  On a
+  compile the wrapper records the program name, the abstract shapes of
+  the arguments that caused it, and the wall seconds of the compiling
+  call (dominated by XLA compile time; the dispatch of the freshly
+  compiled program rides along, which is the honest host-side
+  measurement without private profiler hooks).
+- every event feeds the obs registry: the ``compile_count`` counter, a
+  ``compile_seconds`` wall-time histogram (DEFAULT_TIME_BUCKETS reaches
+  300 s — the compile regime), and a per-program
+  ``compile_count_<program>`` counter, all rendered at ``/metrics`` by
+  ``obs/prom.py``.
+- events append to an in-memory ledger (``events()``, bounded) and — when
+  ``compile_ledger_file`` / the ``LIGHTGBM_TPU_COMPILE_LEDGER`` env var
+  names a path — to an append-only JSONL file, one line per compile,
+  crash-safe by construction (each line is flushed as it happens).
+
+Calls made while another jit is tracing are passed straight through
+(``jax.core.trace_state_clean``): an inner jit inlined into an outer
+trace is not a compilation of its own, and instrumenting it there would
+record trace-time side effects into the account.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from . import registry
+
+ENV_PATH = "LIGHTGBM_TPU_COMPILE_LEDGER"
+
+# In-memory ledger cap: a runaway shape leak should saturate the list,
+# not the process.  The JSONL file (when configured) keeps every event.
+MAX_EVENTS = 4096
+
+_lock = threading.Lock()
+_events: List[Dict[str, Any]] = []
+_dropped = 0
+_path: Optional[str] = os.environ.get(ENV_PATH, "").strip() or None
+
+
+def configure(path: Optional[str] = None) -> Optional[str]:
+    """Set the JSONL sink path for a run.  The
+    ``LIGHTGBM_TPU_COMPILE_LEDGER`` env var wins over the argument (same
+    precedence as the metrics port); no env and no argument clears the
+    sink — each run's configuration is authoritative, so a second
+    ``engine.train`` in the same process cannot keep appending to the
+    previous run's file.  The in-memory ledger is unaffected (always
+    on).  Returns the effective path (None = in-memory only)."""
+    global _path
+    env = os.environ.get(ENV_PATH, "").strip()
+    with _lock:
+        _path = env or (str(path) if path else None)
+        return _path
+
+
+def ledger_path() -> Optional[str]:
+    with _lock:
+        return _path
+
+
+def reset() -> None:
+    """Clear the in-memory ledger (tests).  Registry counters and any
+    JSONL file already written are left alone."""
+    global _dropped
+    with _lock:
+        _events.clear()
+        _dropped = 0
+
+
+def events() -> List[Dict[str, Any]]:
+    """Copy of the in-memory compile events, oldest first."""
+    with _lock:
+        return [dict(e) for e in _events]
+
+
+def total_seconds() -> float:
+    with _lock:
+        return sum(float(e["seconds"]) for e in _events)
+
+
+def slowest(k: int = 5) -> List[Dict[str, Any]]:
+    """The k slowest compile events (for bench tails and reports)."""
+    evs = events()
+    evs.sort(key=lambda e: -float(e["seconds"]))
+    return evs[: max(int(k), 0)]
+
+
+def summary(k: int = 5) -> Dict[str, Any]:
+    """The in-memory account as one JSON-ready block — bench.py's
+    ``compile_events`` key in both modes (one schema, one source)."""
+    return {
+        "count": len(events()),
+        "seconds_total": round(total_seconds(), 3),
+        "slowest": [{"program": e["program"], "shapes": e["shapes"],
+                     "seconds": e["seconds"]} for e in slowest(k)],
+    }
+
+
+def record(program: str, shapes: str, seconds: float) -> Dict[str, Any]:
+    """Append one compile event; feeds the registry series and the JSONL
+    sink.  Called by the instrumented jits — safe to call directly for
+    compilations detected by other means."""
+    global _dropped
+    registry.inc("compile_count")
+    registry.inc("compile_count_" + _sanitize(program))
+    registry.observe("compile_seconds", float(seconds))
+    ev = {
+        "program": str(program),
+        "shapes": str(shapes),
+        "seconds": round(float(seconds), 6),
+        "t": round(time.time(), 3),
+    }
+    with _lock:
+        ev["count"] = registry.get_counter("compile_count")
+        if len(_events) < MAX_EVENTS:
+            _events.append(ev)
+        else:
+            _dropped += 1
+        path = _path
+    if path:
+        try:
+            with open(path, "a") as fh:
+                fh.write(json.dumps(ev) + "\n")
+        except OSError as exc:  # the account must never kill the run
+            from ..utils import log
+            log.warn_once("compile_ledger_write",
+                          "compile ledger %s not writable (%s); events "
+                          "stay in-memory only", path, exc)
+    return ev
+
+
+def read_ledger(path: str) -> List[Dict[str, Any]]:
+    """Parse a compile_ledger.jsonl back into event dicts (a torn final
+    line from a crashed run is dropped, not fatal)."""
+    out: List[Dict[str, Any]] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def _sanitize(name: str) -> str:
+    from . import phases
+    return phases.sanitize(name)
+
+
+# ---------------------------------------------------------------------------
+# the jit wrapper
+
+
+def abstract_shapes(args: tuple, kwargs: Optional[dict] = None,
+                    limit: int = 16) -> str:
+    """Compact abstract-shape signature of a call: ``f32[1024,28],i32[28]``
+    per array leaf (scalars/statics render as short reprs), capped at
+    ``limit`` leaves."""
+    import jax
+    leaves = jax.tree_util.tree_leaves((args, kwargs or {}))
+    parts: List[str] = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            dt = np.dtype(dtype)
+            parts.append(f"{dt.kind}{dt.itemsize * 8}"
+                         f"[{','.join(str(d) for d in shape)}]")
+        else:
+            parts.append(repr(leaf)[:24])
+    if len(parts) > limit:
+        parts = parts[:limit] + [f"+{len(parts) - limit} more"]
+    return ",".join(parts)
+
+
+def _in_trace() -> bool:
+    """True while another jit is tracing this call (inner jits inline —
+    not a compilation of their own)."""
+    import jax
+    try:
+        return not jax.core.trace_state_clean()
+    except Exception:  # pragma: no cover - jax internals moved
+        return False
+
+
+class InstrumentedJit:
+    """Wrap a jitted callable; every XLA compilation it triggers lands
+    in the compile ledger (and the ``compile_count``/``compile_seconds``
+    registry series) with the program name and the abstract shapes that
+    caused it.
+
+    Compile detection reads the jit's executable-cache size before/after
+    each call (the ``CountingJit`` technique, now shared); jax builds
+    without the private ``_cache_size`` API fall back to counting
+    distinct abstract-shape keys — the same signal wherever shapes are
+    the only specialization axis."""
+
+    def __init__(self, fn: Callable, program: str):
+        self._fn = fn
+        self.program = str(program)
+        self._seen_keys: set = set()
+
+    # underlying-jit passthroughs (so stacked wrappers keep detecting)
+    def _cache_size(self) -> Optional[int]:
+        probe = getattr(self._fn, "_cache_size", None)
+        if probe is None:
+            return None
+        try:
+            return int(probe())
+        except Exception:  # pragma: no cover - jax internals moved
+            return None
+
+    def _call_counted(self, *args, **kwargs):
+        """Run the jit; returns ``(out, compiled)`` and records the
+        ledger event when the call compiled."""
+        if _in_trace():
+            return self._fn(*args, **kwargs), False
+        before = self._cache_size()
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        after = self._cache_size()
+        if after is not None:
+            compiled = before is not None and after > before
+        else:  # pragma: no cover - fallback for jax without _cache_size
+            key = abstract_shapes(args, kwargs, limit=64)
+            compiled = key not in self._seen_keys
+            self._seen_keys.add(key)
+        if compiled:
+            record(self.program, abstract_shapes(args, kwargs), dt)
+        return out, compiled
+
+    def __call__(self, *args, **kwargs):
+        return self._call_counted(*args, **kwargs)[0]
+
+
+def instrumented_jit(fn: Optional[Callable] = None, *,
+                     program: Optional[str] = None, **jit_kwargs):
+    """``jax.jit`` with a compile ledger attached.
+
+    Use as a decorator (``@instrumented_jit(program="grow_tree",
+    static_argnames=("params",))``) or as a call
+    (``instrumented_jit(f, program="train_gradients")``).  A callable
+    that is already jitted (has ``lower``) is wrapped as-is — pass no
+    extra jit kwargs in that case."""
+    def wrap(f: Callable) -> InstrumentedJit:
+        import jax
+        jitted = f if (hasattr(f, "lower") and not jit_kwargs) \
+            else jax.jit(f, **jit_kwargs)
+        return InstrumentedJit(
+            jitted, program or getattr(f, "__name__", "jit"))
+    return wrap(fn) if fn is not None else wrap

@@ -1,0 +1,105 @@
+"""HBM watermark sampling at span boundaries.
+
+The [F, B] histogram tensor growth that ROADMAP items 1/3 will attack is
+invisible today: the ``hbm_*_estimate_bytes`` gauges are *predictions*
+(``models/gbdt.py estimate_train_memory``), not measurements.  This
+module measures — cheap, host-side, and OFF by default (``memwatch``
+param / ``LIGHTGBM_TPU_MEMWATCH`` env), because even a host-only walk of
+every live array is not free on a hot serving path:
+
+- ``sample(phase)`` sums ``jax.live_arrays()`` byte sizes (the arrays
+  Python still holds — the steady-state floor of device residency) and,
+  where the backend reports them, reads ``device.memory_stats()``'s
+  ``bytes_in_use`` / ``peak_bytes_in_use`` (the allocator's own
+  watermark, which also sees XLA temporaries).
+- gauges land in the process registry (scrapeable at ``/metrics``):
+  ``memwatch_live_bytes`` / ``memwatch_live_arrays`` (+ the process-wide
+  ``memwatch_peak_live_bytes`` high-water mark, tracked host-side), the
+  per-phase ``memwatch_live_bytes_<phase>`` so each span boundary has
+  its own watermark, and ``memwatch_device_bytes_in_use`` /
+  ``memwatch_device_peak_bytes`` when the backend exposes allocator
+  stats (TPU/GPU; CPU reports none).
+
+``obs.span`` calls ``sample(name)`` on every span exit while enabled, so
+the watermark series line up with the phase taxonomy without any new
+call sites.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from ..utils import coerce_bool as _coerce
+from . import phases, registry
+
+ENV = "LIGHTGBM_TPU_MEMWATCH"
+
+ENABLED = False
+_peak_live = 0
+
+
+def enable(on: bool = True) -> None:
+    global ENABLED
+    ENABLED = bool(on)
+
+
+def configure(flag: Any = None) -> bool:
+    """Resolve the switch for a run: the ``LIGHTGBM_TPU_MEMWATCH`` env
+    var wins over the ``memwatch`` param/config flag; an absent flag
+    (and no env) DISARMS — each run's configuration is authoritative,
+    so a second ``engine.train`` in the same process cannot inherit the
+    previous run's instrumentation.  Returns the new state."""
+    env = os.environ.get(ENV, "").strip()
+    if env:
+        enable(_coerce(env))
+    else:
+        enable(_coerce(flag) if flag is not None else False)
+    return ENABLED
+
+
+def reset_peak() -> None:
+    global _peak_live
+    _peak_live = 0
+
+
+def sample(phase: Optional[str] = None,
+           reg: Optional[registry.Registry] = None) -> Dict[str, int]:
+    """Take one watermark sample; sets the gauges and returns them.
+    Host-side only — nothing here blocks the device pipeline."""
+    global _peak_live
+    import jax
+    r = reg if reg is not None else registry.REGISTRY
+    live = 0
+    n = 0
+    try:
+        for a in jax.live_arrays():
+            live += int(getattr(a, "nbytes", 0) or 0)
+            n += 1
+    except Exception:  # pragma: no cover - backend without live_arrays
+        live, n = -1, -1
+    out: Dict[str, int] = {"live_bytes": live, "live_arrays": n}
+    if live >= 0:
+        if live > _peak_live:
+            _peak_live = live
+        r.set_gauge("memwatch_live_bytes", live)
+        r.set_gauge("memwatch_live_arrays", n)
+        r.set_gauge("memwatch_peak_live_bytes", _peak_live)
+        if phase:
+            r.set_gauge("memwatch_live_bytes_" + phases.sanitize(phase),
+                        live)
+        out["peak_live_bytes"] = _peak_live
+    try:
+        stats = jax.devices()[0].memory_stats()
+    except Exception:  # pragma: no cover - backend without memory_stats
+        stats = None
+    if stats:
+        in_use = stats.get("bytes_in_use")
+        peak = stats.get("peak_bytes_in_use")
+        if in_use is not None:
+            r.set_gauge("memwatch_device_bytes_in_use", int(in_use))
+            out["device_bytes_in_use"] = int(in_use)
+        if peak is not None:
+            r.set_gauge("memwatch_device_peak_bytes", int(peak))
+            out["device_peak_bytes"] = int(peak)
+    return out

@@ -31,6 +31,8 @@ HOST_PHASES = frozenset({
     "GBDT::host_tree",
     "GBDT::metric",
     # serving subsystem (lightgbm_tpu/serve/, docs/SERVING.md)
+    "Serve::request",     # whole HTTP request (causal-trace root)
+    "Serve::queue",       # enqueue -> coalesced-batch pickup wait
     "Serve::batch",       # micro-batch assembly + device dispatch
     "Predict::forest",    # one CompiledForest bucket call
 })

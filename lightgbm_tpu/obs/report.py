@@ -19,6 +19,17 @@ model file, no live process:
 Multiple files concatenate (multihost runs write one stream per rank;
 fold workers one per fold) — per-file iteration counts are reported so
 overlapping indices are visible rather than silently summed.
+
+Two sibling inputs ride the same CLI (docs/OBSERVABILITY.md):
+
+- ``--compile=<compile_ledger.jsonl>`` adds a compile section — total
+  compile seconds, per-program totals, and the slowest-K compile events
+  WITH their abstract input shapes, so a 300-second warmup is
+  attributable to the program and shape that bought it;
+- ``--traces`` switches the positional files to Chrome trace-event JSON
+  (the ``trace_events_file`` export): per-root span stats, coalesce
+  fan-in, and the critical path of the slowest requests/rounds
+  (queue -> batch -> device predict decomposition).
 """
 
 from __future__ import annotations
@@ -53,7 +64,35 @@ def _merge_by_iter(evs: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return [merged[it] for it in order]
 
 
-def summarize(paths: Sequence[str], top_k: int = 5) -> Dict[str, Any]:
+def summarize_compile(path: str, top_k: int = 5) -> Dict[str, Any]:
+    """Summarize a compile_ledger.jsonl: totals, per-program seconds,
+    slowest-k events with shapes (the ``--compile=`` section)."""
+    from .compile_ledger import read_ledger
+    evs = read_ledger(path)
+    per_program: Dict[str, Dict[str, Any]] = {}
+    for e in evs:
+        st = per_program.setdefault(str(e.get("program", "?")),
+                                    {"count": 0, "seconds": 0.0})
+        st["count"] += 1
+        st["seconds"] += float(e.get("seconds", 0.0))
+    for st in per_program.values():
+        st["seconds"] = round(st["seconds"], 3)
+    evs.sort(key=lambda e: -float(e.get("seconds", 0.0)))
+    return {
+        "file": str(path),
+        "count": len(evs),
+        "seconds_total": round(sum(float(e.get("seconds", 0.0))
+                                   for e in evs), 3),
+        "programs": per_program,
+        "slowest": [{"program": e.get("program"),
+                     "shapes": e.get("shapes"),
+                     "seconds": e.get("seconds")}
+                    for e in evs[: max(int(top_k), 0)]],
+    }
+
+
+def summarize(paths: Sequence[str], top_k: int = 5,
+              compile_path: Optional[str] = None) -> Dict[str, Any]:
     """Aggregate one or more event files into a report dict (the
     ``--format=json`` payload; ``render_table`` prints the same dict).
     Records are merged per iteration index WITHIN each file (ranks/folds
@@ -126,7 +165,7 @@ def summarize(paths: Sequence[str], top_k: int = 5) -> Dict[str, Any]:
                 "n": len(values),
             }
 
-    return {
+    rep: Dict[str, Any] = {
         "files": per_file,
         "events": len(events),
         "iterations": committed,
@@ -142,6 +181,9 @@ def summarize(paths: Sequence[str], top_k: int = 5) -> Dict[str, Any]:
         "comm": {"bytes_cum": comm_bytes, "calls_cum": comm_calls},
         "eval": eval_summary,
     }
+    if compile_path:
+        rep["compile"] = summarize_compile(compile_path, top_k=top_k)
+    return rep
 
 
 def _fmt_bytes(n: int) -> str:
@@ -196,6 +238,18 @@ def render_table(rep: Dict[str, Any]) -> str:
     out.append(f"-- collective traffic: {_fmt_bytes(comm['bytes_cum'])} "
                f"over {comm['calls_cum']} calls --")
 
+    if rep.get("compile"):
+        comp = rep["compile"]
+        out.append(f"-- compile ledger: {comp['count']} compiles, "
+                   f"{comp['seconds_total']:.3f}s total --")
+        for name, st in sorted(comp["programs"].items(),
+                               key=lambda t: -t[1]["seconds"]):
+            out.append(f"  {name:<24} {st['seconds']:>10.3f}s  "
+                       f"x{st['count']}")
+        for e in comp["slowest"]:
+            out.append(f"  slowest: {e['program']} {e['seconds']:.3f}s  "
+                       f"{e['shapes']}")
+
     if rep["eval"]:
         out.append("-- eval trajectory --")
         for ds in sorted(rep["eval"]):
@@ -207,12 +261,44 @@ def render_table(rep: Dict[str, Any]) -> str:
     return "\n".join(out)
 
 
+def render_traces_table(rep: Dict[str, Any]) -> str:
+    """Human-readable ``--traces`` summary."""
+    out: List[str] = []
+    out.append("== obs-report (traces) ==")
+    for path, n in rep["files"].items():
+        out.append(f"file: {path} ({n} events)")
+    out.append(f"traces: {rep['traces']}")
+    if rep["roots"]:
+        out.append("-- per-root span stats --")
+        for name, st in sorted(rep["roots"].items(),
+                               key=lambda t: -t[1]["total_s"]):
+            out.append(f"  {name:<20} x{st['count']:<6} "
+                       f"total {st['total_s']:.4f}s  "
+                       f"mean {st['mean_s'] * 1000.0:.2f}ms  "
+                       f"max {st['max_s'] * 1000.0:.2f}ms")
+    co = rep["coalesce"]
+    out.append(f"-- coalescing: {co['batches']} batches, fan-in "
+               f"mean {co['mean_fan_in']} max {co['max_fan_in']} --")
+    if rep["slowest"]:
+        out.append(f"-- slowest {len(rep['slowest'])} traces "
+                   f"(critical path) --")
+        for t in rep["slowest"]:
+            path = " -> ".join(
+                f"{s['name']} {s['dur_s'] * 1000.0:.2f}ms"
+                for s in t["critical_path"])
+            out.append(f"  [{t['trace_id']}] {path}")
+    return "\n".join(out)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     """CLI entry: ``python -m lightgbm_tpu obs-report <events.jsonl ...>
-    [--format=json|table] [--top=K]``."""
+    [--format=json|table] [--top=K] [--compile=<ledger.jsonl>]`` or
+    ``obs-report --traces <trace.json ...>``."""
     argv = list(sys.argv[1:] if argv is None else argv)
     fmt = "table"
     top_k = 5
+    compile_path: Optional[str] = None
+    traces_mode = False
     paths: List[str] = []
     for tok in argv:
         if tok.startswith("--format="):
@@ -224,6 +310,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 print(f"obs-report: bad --top value in {tok!r}",
                       file=sys.stderr)
                 return 2
+        elif tok.startswith("--compile="):
+            compile_path = tok.split("=", 1)[1]
+        elif tok == "--traces":
+            traces_mode = True
         elif tok.startswith("-"):
             print(f"obs-report: unknown flag {tok!r}", file=sys.stderr)
             return 2
@@ -231,21 +321,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             paths.append(tok)
     if not paths:
         print("usage: python -m lightgbm_tpu obs-report <events.jsonl ...> "
-              "[--format=json|table] [--top=K]", file=sys.stderr)
+              "[--format=json|table] [--top=K] "
+              "[--compile=<compile_ledger.jsonl>]\n"
+              "       python -m lightgbm_tpu obs-report --traces "
+              "<trace_events.json ...> [--format=json|table] [--top=K]",
+              file=sys.stderr)
         return 2
     if fmt not in ("json", "table"):
         print(f"obs-report: unknown format {fmt!r} (json|table)",
               file=sys.stderr)
         return 2
     try:
-        rep = summarize(paths, top_k=top_k)
-    except (OSError, ValueError) as exc:
+        if traces_mode:
+            from .tracing import summarize_traces
+            rep = summarize_traces(paths, top_k=top_k)
+        else:
+            rep = summarize(paths, top_k=top_k, compile_path=compile_path)
+    except (OSError, ValueError, KeyError) as exc:
         # ValueError covers json.JSONDecodeError: a crashed run can leave
         # a torn final line — report it as a one-liner, not a traceback
         print(f"obs-report: {exc}", file=sys.stderr)
         return 1
     if fmt == "json":
         print(json.dumps(rep, indent=2, sort_keys=True))
+    elif traces_mode:
+        print(render_traces_table(rep))
     else:
         print(render_table(rep))
     return 0

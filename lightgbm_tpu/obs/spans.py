@@ -19,6 +19,18 @@ side remains the trace capture's job.  The two instruments are unified:
 
 ``timed(name)`` wraps a function in a span — decorator sugar for
 hot-path-free helpers (model export, report generation).
+
+Two optional instruments piggyback on the span boundaries (both off by
+default, both gated on one module-attribute read):
+
+- causal tracing (obs/tracing.py): when the tracer is armed, every span
+  also records a parent-linked trace span (contextvar propagation), so
+  ``GBDT::iteration`` / ``Serve::batch`` land in the Chrome trace export
+  with trace IDs for free.  The yielded handle's ``trace`` attribute is
+  the tracing SpanHandle (None when disabled) — the batcher uses it to
+  record many-to-one coalesce edges.
+- memwatch (obs/memwatch.py): when enabled, span exit samples the HBM
+  watermark gauges under the span's phase name.
 """
 
 from __future__ import annotations
@@ -28,7 +40,7 @@ import time
 from contextlib import contextmanager
 from typing import Callable, Optional, Sequence
 
-from . import phases, registry
+from . import memwatch, phases, registry, tracing
 
 
 # span names are a small fixed set (the phase taxonomy); memoize the
@@ -46,12 +58,15 @@ def _series(name: str) -> str:
 class _SpanHandle:
     """Yielded by ``span``: ``sync(x)`` registers device values to block
     on before the clock stops — honored only under the serializing
-    TIMETAG mode, so production spans never force a host sync."""
+    TIMETAG mode, so production spans never force a host sync.
+    ``trace`` is the causal-tracing span handle (None unless the tracer
+    is armed, obs/tracing.py)."""
 
-    __slots__ = ("value",)
+    __slots__ = ("value", "trace")
 
     def __init__(self):
         self.value = None
+        self.trace = None
 
     def sync(self, value) -> None:
         self.value = value
@@ -66,6 +81,10 @@ def span(name: str, buckets: Optional[Sequence[float]] = None,
     r = reg if reg is not None else registry.REGISTRY
     handle = _SpanHandle()
     serialize = timetag.ENABLED
+    token = None
+    if tracing.TRACER.enabled:
+        handle.trace = tracing.TRACER.begin(name)
+        token = tracing.push(handle.trace)
     t0 = time.perf_counter()
     try:
         yield handle
@@ -77,6 +96,11 @@ def span(name: str, buckets: Optional[Sequence[float]] = None,
         r.observe(_series(name), dt, buckets)
         if serialize:
             timetag.add(name, dt)
+        if handle.trace is not None:
+            tracing.pop(token)
+            tracing.TRACER.end(handle.trace)
+        if memwatch.ENABLED:
+            memwatch.sample(name, reg=r)
 
 
 def timed(name: str, buckets: Optional[Sequence[float]] = None) -> Callable:

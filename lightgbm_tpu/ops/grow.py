@@ -28,6 +28,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..obs.compile_ledger import instrumented_jit
+
 from .histogram import children_histograms, root_histogram
 from .split import (BestSplit, SplitParams, find_best_split, leaf_output,
                     K_MIN_SCORE)
@@ -283,7 +285,7 @@ def _store_leaf_split(state: _GrowState, leaf, split: BestSplit) -> _GrowState:
     )
 
 
-@functools.partial(jax.jit, static_argnames=("params", "comm"))
+@instrumented_jit(program="grow_tree", static_argnames=("params", "comm"))
 def grow_tree(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
               learning_rate, params: GrowParams, comm=None, bins_rm=None):
     """Grow one tree.  All inputs are device arrays.

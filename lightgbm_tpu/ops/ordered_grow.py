@@ -56,6 +56,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..obs.compile_ledger import instrumented_jit
 from . import leafhist
 from .grow import GrowParams, TreeArrays
 from .split import BestSplit, find_best_split, leaf_output, K_MIN_SCORE
@@ -119,7 +120,7 @@ def _put_row(buf, i, vec):
     return jax.lax.dynamic_update_slice(buf, vec[None, :], (i, 0))
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
+@instrumented_jit(program="grow_tree_ordered", static_argnames=("params",))
 def grow_tree_ordered(bins, num_bin, is_cat, feat_mask, grad, hess,
                       row_weight, learning_rate, params: GrowParams,
                       bins_rm=None, bins_words=None):

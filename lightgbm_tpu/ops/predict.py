@@ -15,6 +15,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..obs.compile_ledger import instrumented_jit
+
 
 def predict_binned_tree(split_feature, split_bin, is_cat_node, left_child,
                         right_child, leaf_value, bins, max_steps: int):
@@ -103,7 +105,8 @@ def predict_binned_forest(split_feature, split_bin, is_cat_node, left_child,
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("max_steps",))
+@instrumented_jit(program="predict_leaves",
+                  static_argnames=("max_steps",))
 def predict_leaf_indices_forest(split_feature, split_bin, is_cat_node,
                                 left_child, right_child, leaf_value, bins,
                                 max_steps: int):

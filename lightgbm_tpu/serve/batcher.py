@@ -13,11 +13,19 @@ pre-compilable by ``warmup()``.
 ``CountingJit`` wraps a jitted callable and turns its executable-cache
 growth into obs counters (``<prefix>_compiles``,
 ``<prefix>_compiles_bucket_<B>``), which is what the "zero new compiles
-after warmup" acceptance gate reads.
+after warmup" acceptance gate reads.  The compile *detection* (and the
+program-name/shapes/seconds record every compile now leaves behind)
+lives in ``obs/compile_ledger.py InstrumentedJit`` — this class adds
+only the bucket-axis counters on top.
 
 ``MicroBatcher`` is the concurrency half: concurrent ``submit()`` calls
 coalesce into one device batch under a max-latency deadline, so p99
-stays bounded while small requests ride along with big ones.
+stays bounded while small requests ride along with big ones.  When the
+causal tracer is armed (obs/tracing.py) every request carries a
+``Serve::queue`` span from enqueue to batch pickup, and each device
+batch records explicit many-to-one coalesce edges from the requests it
+absorbed — the trace export shows exactly which requests shared a batch
+and how long each waited.
 """
 
 from __future__ import annotations
@@ -76,44 +84,23 @@ class BucketLadder:
         return out
 
 
-class CountingJit:
+class CountingJit(obs.InstrumentedJit):
     """Wrap a ``jax.jit`` callable; surface its compiles as obs counters.
 
     The jit's executable cache size is read before/after each call: a
-    growth means this call shape-missed and XLA compiled.  Counters:
-    ``<prefix>_compiles`` (total), ``<prefix>_compiles_bucket_<B>`` (per
-    bucket), ``<prefix>_calls``.  When the private ``_cache_size`` API is
-    unavailable the wrapper falls back to counting distinct shape keys it
-    has seen — same signal for the bucket-ladder use case, where shapes
-    are the only specialization axis."""
+    growth means this call shape-missed and XLA compiled (the shared
+    ``obs.InstrumentedJit`` detection, which also lands every compile in
+    the process compile ledger with program name, shapes, and wall
+    seconds).  Counters: ``<prefix>_compiles`` (total),
+    ``<prefix>_compiles_bucket_<B>`` (per bucket), ``<prefix>_calls``."""
 
     def __init__(self, fn: Callable, prefix: str):
-        self._fn = fn
+        super().__init__(fn, prefix)
         self.prefix = prefix
-        self._seen_keys = set()
-
-    def _cache_size(self) -> Optional[int]:
-        probe = getattr(self._fn, "_cache_size", None)
-        if probe is None:
-            return None
-        try:
-            return int(probe())
-        except Exception:  # pragma: no cover - jax internals moved
-            return None
 
     def __call__(self, bucket: int, *args, **kwargs):
-        before = self._cache_size()
-        out = self._fn(*args, **kwargs)
+        out, compiled = self._call_counted(*args, **kwargs)
         obs.inc(f"{self.prefix}_calls")
-        after = self._cache_size()
-        if after is not None:
-            compiled = before is not None and after > before
-        else:  # pragma: no cover - fallback for jax without _cache_size
-            key = tuple(
-                (getattr(a, "shape", None), str(getattr(a, "dtype", "")))
-                for a in args) + tuple(sorted(kwargs.items()))
-            compiled = key not in self._seen_keys
-            self._seen_keys.add(key)
         if compiled:
             obs.inc(f"{self.prefix}_compiles")
             obs.inc(f"{self.prefix}_compiles_bucket_{bucket}")
@@ -133,7 +120,7 @@ def pad_rows(X: np.ndarray, bucket: int):
 
 
 class _Pending:
-    __slots__ = ("rows", "done", "result", "error", "t0")
+    __slots__ = ("rows", "done", "result", "error", "t0", "tspan")
 
     def __init__(self, rows: np.ndarray):
         self.rows = rows
@@ -141,6 +128,11 @@ class _Pending:
         self.result = None
         self.error: Optional[BaseException] = None
         self.t0 = time.perf_counter()
+        # causal trace: the queue-wait span (enqueue -> batch pickup),
+        # child of the submitting context's request span; None when the
+        # tracer is disarmed.  Ended by the WORKER thread at pickup.
+        self.tspan = obs.trace_begin("Serve::queue",
+                                     args={"rows": int(rows.shape[0])})
 
 
 class MicroBatcher:
@@ -196,8 +188,14 @@ class MicroBatcher:
             # would still be computed AND hold max_batch capacity ahead
             # of live requests, compounding the overload it signals
             with self._cond:
-                if req in self._queue:
+                shed = req in self._queue
+                if shed:
                     self._queue.remove(req)
+            if shed:
+                # still queued -> the worker never picked it up and will
+                # never end its queue span; a picked-up-but-slow request
+                # had its span closed at batch start
+                obs.trace_end(req.tspan, args={"shed": True})
             obs.inc("serve_timeouts_shed")
             raise TimeoutError("predict request timed out")
         if req.error is not None:
@@ -252,10 +250,21 @@ class MicroBatcher:
             if not batch:          # spurious wakeup at shutdown
                 continue
             try:
-                with obs.span("Serve::batch"):
+                with obs.span("Serve::batch") as sp:
+                    if sp.trace is not None:
+                        # many-to-one coalesce edges: each absorbed
+                        # request's queue span ends here and links into
+                        # this batch span (trace-ID continuity for the
+                        # request trees is via member_trace_ids)
+                        for req in batch:
+                            obs.trace_link(req.tspan, sp.trace)
+                            obs.trace_end(req.tspan)
+                        sp.trace.args["coalesced"] = len(batch)
                     rows = (batch[0].rows if len(batch) == 1 else
                             np.concatenate([r.rows for r in batch], axis=0))
-                    out = self.predict_fn(rows)
+                    with obs.trace_span("Predict::forest",
+                                        args={"rows": int(rows.shape[0])}):
+                        out = self.predict_fn(rows)
                 obs.inc("serve_batches")
                 obs.inc("serve_batch_rows", int(rows.shape[0]))
                 obs.set_gauge("serve_last_batch_rows", int(rows.shape[0]))

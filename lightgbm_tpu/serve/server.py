@@ -31,6 +31,7 @@ the HTTP threads.
 
 from __future__ import annotations
 
+import itertools
 import json
 import signal
 import threading
@@ -43,6 +44,12 @@ from .. import obs
 from ..utils import log
 from .batcher import MicroBatcher
 from .forest import CompiledForest
+
+# monotonically increasing request ids: echoed in the X-Request-Id
+# response header and attached to each request's causal-trace root span,
+# so a slow response is findable in the Perfetto export by the id the
+# client saw
+_request_ids = itertools.count(1)
 
 
 def _parse_rows(body: bytes, content_type: str):
@@ -114,11 +121,14 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # pragma: no cover - log plumbing
         log.debug("serve: " + fmt, *args)
 
-    def _reply(self, code: int, payload: dict) -> None:
+    def _reply(self, code: int, payload: dict,
+               request_id: Optional[int] = None) -> None:
         body = json.dumps(payload).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if request_id is not None:
+            self.send_header("X-Request-Id", str(request_id))
         self.end_headers()
         self.wfile.write(body)
 
@@ -148,39 +158,47 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/predict":
             self._reply(404, {"error": f"unknown path {self.path}"})
             return
-        try:
-            length = int(self.headers.get("Content-Length", 0))
-            body = self.rfile.read(length)
-            rows, raw_score = _parse_rows(
-                body, self.headers.get("Content-Type", ""))
-            # validate per request BEFORE coalescing: a malformed width
-            # must 400 here, not poison every request sharing its batch
-            if rows.shape[0] == 0:
-                raise ValueError("no rows in request")
-            if rows.shape[1] != srv.forest.num_features:
-                raise ValueError(
-                    f"expected {srv.forest.num_features} features per "
-                    f"row, got {rows.shape[1]}")
-        except Exception as exc:
-            obs.inc("serve_bad_requests")
-            self._reply(400, {"error": f"bad request: {exc}"})
-            return
-        try:
-            raw, out = srv.batcher.submit(rows, timeout=srv.request_timeout)
-            self._reply(200, {
-                "predictions": _json_predictions(raw, out, raw_score),
-                "num_rows": int(rows.shape[0]),
-            })
-        except TimeoutError:
-            obs.inc("serve_timeouts")
-            self._reply(503, {"error": "prediction timed out"})
-        except RuntimeError:
-            # batcher closed: we are mid graceful shutdown — retryable
-            obs.inc("serve_shedding")
-            self._reply(503, {"error": "server shutting down"})
-        except Exception as exc:
-            obs.inc("serve_errors")
-            self._reply(500, {"error": str(exc)})
+        req_id = next(_request_ids)
+        # causal-trace root: one trace per HTTP request.  Everything the
+        # request causes (queue wait, the coalesced batch it rides, the
+        # device predict) hangs off this span in the trace export.
+        with obs.trace_span("Serve::request", args={"request_id": req_id}):
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                rows, raw_score = _parse_rows(
+                    body, self.headers.get("Content-Type", ""))
+                # validate per request BEFORE coalescing: a malformed
+                # width must 400 here, not poison every request sharing
+                # its batch
+                if rows.shape[0] == 0:
+                    raise ValueError("no rows in request")
+                if rows.shape[1] != srv.forest.num_features:
+                    raise ValueError(
+                        f"expected {srv.forest.num_features} features per "
+                        f"row, got {rows.shape[1]}")
+            except Exception as exc:
+                obs.inc("serve_bad_requests")
+                self._reply(400, {"error": f"bad request: {exc}"}, req_id)
+                return
+            try:
+                raw, out = srv.batcher.submit(rows,
+                                              timeout=srv.request_timeout)
+                self._reply(200, {
+                    "predictions": _json_predictions(raw, out, raw_score),
+                    "num_rows": int(rows.shape[0]),
+                    "request_id": req_id,
+                }, req_id)
+            except TimeoutError:
+                obs.inc("serve_timeouts")
+                self._reply(503, {"error": "prediction timed out"}, req_id)
+            except RuntimeError:
+                # batcher closed: mid graceful shutdown — retryable
+                obs.inc("serve_shedding")
+                self._reply(503, {"error": "server shutting down"}, req_id)
+            except Exception as exc:
+                obs.inc("serve_errors")
+                self._reply(500, {"error": str(exc)}, req_id)
 
 
 class PredictServer:
@@ -235,6 +253,9 @@ class PredictServer:
             self._thread.join(timeout=10.0)
         self.batcher.close(drain=True)
         self.httpd.server_close()
+        # flush the causal trace AFTER the drain so the last batch's
+        # spans are in the export
+        obs.TRACER.maybe_export()
         log.info("serve: shut down cleanly (%d requests, %d batches)",
                  obs.get_counter("serve_requests"),
                  obs.get_counter("serve_batches"))
@@ -273,6 +294,13 @@ def serve_from_config(config, params=None) -> PredictServer:
 
     if not config.input_model:
         log.fatal("No model file specified (input_model=...)")
+    # deep-observability switches (docs/OBSERVABILITY.md): compile
+    # ledger, HBM watermarks, causal trace export — all off unless
+    # configured, all env-var overridable
+    from ..obs import compile_ledger, memwatch
+    compile_ledger.configure(config.compile_ledger_file or None)
+    memwatch.configure(config.memwatch)
+    obs.TRACER.configure(config.trace_events_file or None)
     booster = Booster(params=dict(params or {}),
                       model_file=config.input_model)
     # Cap the ladder at serve_max_batch: warmup() compiles every bucket

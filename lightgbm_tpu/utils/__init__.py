@@ -1,1 +1,12 @@
 from . import log  # noqa: F401
+
+
+def coerce_bool(value) -> bool:
+    """The repo's single bool-coercion rule (config params, env flags):
+    shared by config.py and the obs switches so CLI spellings and env
+    vars can never parse differently."""
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, (int, float)):
+        return bool(value)
+    return str(value).strip().lower() in ("true", "1", "yes", "y", "t", "+")

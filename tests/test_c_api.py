@@ -1,281 +1,96 @@
-"""C API parity: the reference tests/c_api_test/test.py flow, driven both
-through the compiled lib_lightgbm_tpu.so (ctypes, exactly like a C caller)
-and in-process against capi.impl.
+"""C API parity: the reference tests/c_api_test/test.py flow through the
+compiled lib_lightgbm_tpu.so (ctypes, exactly like a C caller).
 
 Reference: include/LightGBM/c_api.h:37-717, src/c_api.cpp, and
-tests/c_api_test/test.py (the flow replicated here: create-from-file /
--mat / -CSR / -CSC, save binary, booster create, 30-iteration train loop
-with GetEval, save model, reload, PredictForMat / PredictForFile)."""
+tests/c_api_test/test.py (create-from-file / -mat / -CSR / -CSC, save
+binary, booster create, 30-iteration train loop with GetEval, save
+model, reload, PredictForMat / PredictForFile, PushRows streaming).
 
-import ctypes
+The library is driven from a SUBPROCESS (tests/c_api_worker.py), not
+in-process: the cffi embedding boots an embedded CPython on its first
+call, and that native boot spins forever when the host process already
+holds an initialized jax — which pytest's conftest guarantees.  This was
+ROADMAP item 6: the in-process version of this file hung the whole
+tier-1 suite at its timeout.  The pytest process only *builds* the
+shared library (compilation never touches the embedded runtime); one
+worker subprocess then runs every scenario against it — one clean boot,
+one set of jit compiles — and writes per-scenario verdicts this module
+asserts on.
+
+The in-process surface (lightgbm_tpu.capi.impl) stays covered through
+the library: the embedded init code dispatches every LGBM_* symbol to
+impl.py.
+"""
+
+import json
 import os
+import subprocess
+import sys
 
-import numpy as np
 import pytest
 
+# referenced by the scenarios that need the read-only /root/reference
+# mount; conftest skips those tests per-item when it is absent, and the
+# worker double-checks so the module fixture stays runnable either way
 BINARY_TRAIN = "/root/reference/examples/binary_classification/binary.train"
 BINARY_TEST = "/root/reference/examples/binary_classification/binary.test"
 
-dtype_float32 = 0
-dtype_float64 = 1
-dtype_int32 = 2
-dtype_int64 = 3
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "c_api_worker.py")
 
-
-def _load_tsv(path):
-    d = np.loadtxt(path)
-    return d[:, 1:], d[:, 0].astype(np.float32)
+# a clean process completes the embedded boot + full train flow in well
+# under a minute on this box; the cap exists so a reintroduced boot hang
+# fails THIS file instead of eating the tier-1 suite's whole budget
+_WORKER_TIMEOUT_S = 420
 
 
 @pytest.fixture(scope="module")
-def LIB():
+def capi_results(tmp_path_factory):
+    """Build the library in-process (safe: compile only, no load), run
+    every scenario in one clean subprocess, return its verdicts."""
     from lightgbm_tpu.capi import build_library
-    path = build_library()
-    lib = ctypes.cdll.LoadLibrary(path)
-    lib.LGBM_GetLastError.restype = ctypes.c_char_p
-    return lib
+    lib_path = build_library()
+    out = tmp_path_factory.mktemp("capi") / "results.json"
+    data_dir = tmp_path_factory.mktemp("capi_data")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, _WORKER, lib_path, str(out), str(data_dir)],
+            timeout=_WORKER_TIMEOUT_S, capture_output=True, text=True,
+            env=env)
+    except subprocess.TimeoutExpired:
+        pytest.fail(f"c_api worker exceeded {_WORKER_TIMEOUT_S}s — the "
+                    f"embedded-interpreter boot hang is back?")
+    assert proc.returncode == 0, (proc.stdout[-1000:], proc.stderr[-2000:])
+    return json.loads(out.read_text())
 
 
-def c_str(s):
-    return ctypes.c_char_p(s.encode("ascii"))
+def _scenario(results, name):
+    rec = results[name]
+    if rec["status"] == "skip":
+        pytest.skip(rec.get("detail", "skipped by worker"))
+    assert rec["status"] == "ok", rec.get("detail", "")
 
 
-def _check(lib, ret):
-    assert ret == 0, lib.LGBM_GetLastError()
+def test_error_reporting(capi_results):
+    """LGBM_GetLastError carries the failure of a bad CreateFromFile."""
+    _scenario(capi_results, "error_reporting")
 
 
-def _mat_handle(lib, X, y, params, reference=None):
-    X = np.ascontiguousarray(X, np.float64)
-    handle = ctypes.c_void_p()
-    _check(lib, lib.LGBM_DatasetCreateFromMat(
-        X.ctypes.data_as(ctypes.c_void_p), dtype_float64,
-        ctypes.c_int32(X.shape[0]), ctypes.c_int32(X.shape[1]), 1,
-        c_str(params), reference, ctypes.byref(handle)))
-    if y is not None:
-        y = np.ascontiguousarray(y, np.float32)
-        _check(lib, lib.LGBM_DatasetSetField(
-            handle, c_str("label"), y.ctypes.data_as(ctypes.c_void_p),
-            ctypes.c_int(len(y)), dtype_float32))
-    return handle
-
-
-def test_dataset_file_mat_csr_csc(LIB, tmp_path):
-    # from file
-    train = ctypes.c_void_p()
-    _check(LIB, LIB.LGBM_DatasetCreateFromFile(
-        c_str(BINARY_TRAIN), c_str("max_bin=15"), None, ctypes.byref(train)))
-    num_data = ctypes.c_int(0)
-    num_feat = ctypes.c_int(0)
-    _check(LIB, LIB.LGBM_DatasetGetNumData(train, ctypes.byref(num_data)))
-    _check(LIB, LIB.LGBM_DatasetGetNumFeature(train, ctypes.byref(num_feat)))
-    assert num_data.value == 7000 and num_feat.value == 28
-
-    X, y = _load_tsv(BINARY_TEST)
-
-    # from mat, aligned to train's mappers
-    test_h = _mat_handle(LIB, X, y, "max_bin=15", train)
-    _check(LIB, LIB.LGBM_DatasetGetNumData(test_h, ctypes.byref(num_data)))
-    assert num_data.value == 500
-    _check(LIB, LIB.LGBM_DatasetFree(test_h))
-
-    # from CSR
-    from scipy import sparse
-    csr = sparse.csr_matrix(X)
-    h = ctypes.c_void_p()
-    _check(LIB, LIB.LGBM_DatasetCreateFromCSR(
-        csr.indptr.ctypes.data_as(ctypes.c_void_p), dtype_int32,
-        csr.indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        csr.data.ctypes.data_as(ctypes.c_void_p), dtype_float64,
-        ctypes.c_int64(len(csr.indptr)), ctypes.c_int64(csr.nnz),
-        ctypes.c_int64(X.shape[1]), c_str("max_bin=15"), train,
-        ctypes.byref(h)))
-    _check(LIB, LIB.LGBM_DatasetGetNumData(h, ctypes.byref(num_data)))
-    assert num_data.value == 500
-    _check(LIB, LIB.LGBM_DatasetFree(h))
-
-    # from CSC
-    csc = sparse.csc_matrix(X)
-    _check(LIB, LIB.LGBM_DatasetCreateFromCSC(
-        csc.indptr.ctypes.data_as(ctypes.c_void_p), dtype_int32,
-        csc.indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        csc.data.ctypes.data_as(ctypes.c_void_p), dtype_float64,
-        ctypes.c_int64(len(csc.indptr)), ctypes.c_int64(csc.nnz),
-        ctypes.c_int64(X.shape[0]), c_str("max_bin=15"), train,
-        ctypes.byref(h)))
-    _check(LIB, LIB.LGBM_DatasetGetNumData(h, ctypes.byref(num_data)))
-    assert num_data.value == 500
-    _check(LIB, LIB.LGBM_DatasetFree(h))
-
-    # save binary, reload
-    bin_path = str(tmp_path / "train.binary.bin")
-    _check(LIB, LIB.LGBM_DatasetSaveBinary(train, c_str(bin_path)))
-    _check(LIB, LIB.LGBM_DatasetFree(train))
-    _check(LIB, LIB.LGBM_DatasetCreateFromFile(
-        c_str(bin_path), c_str("max_bin=15"), None, ctypes.byref(train)))
-    _check(LIB, LIB.LGBM_DatasetGetNumData(train, ctypes.byref(num_data)))
-    assert num_data.value == 7000
-    _check(LIB, LIB.LGBM_DatasetFree(train))
-
-
-def test_booster_train_save_predict(LIB, tmp_path):
-    Xtr, ytr = _load_tsv(BINARY_TRAIN)
-    Xte, yte = _load_tsv(BINARY_TEST)
-    train = _mat_handle(LIB, Xtr, ytr, "max_bin=63")
-    test = _mat_handle(LIB, Xte, yte, "max_bin=63", train)
-
-    booster = ctypes.c_void_p()
-    _check(LIB, LIB.LGBM_BoosterCreate(
-        train, c_str("app=binary metric=auc num_leaves=15 verbose=-1"),
-        ctypes.byref(booster)))
-    _check(LIB, LIB.LGBM_BoosterAddValidData(booster, test))
-
-    n_classes = ctypes.c_int(0)
-    _check(LIB, LIB.LGBM_BoosterGetNumClasses(booster, ctypes.byref(n_classes)))
-    assert n_classes.value == 1
-
-    is_finished = ctypes.c_int(0)
-    aucs = []
-    for _ in range(30):
-        _check(LIB, LIB.LGBM_BoosterUpdateOneIter(booster,
-                                                  ctypes.byref(is_finished)))
-        result = np.zeros(1, dtype=np.float64)
-        out_len = ctypes.c_int(0)
-        _check(LIB, LIB.LGBM_BoosterGetEval(
-            booster, 1, ctypes.byref(out_len),
-            result.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
-        assert out_len.value == 1
-        aucs.append(result[0])
-    assert aucs[-1] > 0.80 and aucs[-1] >= aucs[0]
-
-    it = ctypes.c_int(0)
-    _check(LIB, LIB.LGBM_BoosterGetCurrentIteration(booster, ctypes.byref(it)))
-    assert it.value == 30
-
-    # eval names
-    cnt = ctypes.c_int(0)
-    _check(LIB, LIB.LGBM_BoosterGetEvalCounts(booster, ctypes.byref(cnt)))
-    assert cnt.value == 1
-    bufs = [ctypes.create_string_buffer(255)]
-    arr = (ctypes.c_char_p * 1)(*map(ctypes.addressof, bufs))
-    _check(LIB, LIB.LGBM_BoosterGetEvalNames(booster, ctypes.byref(cnt), arr))
-    assert bufs[0].value == b"auc"
-
-    model_path = str(tmp_path / "model.txt")
-    _check(LIB, LIB.LGBM_BoosterSaveModel(booster, -1, c_str(model_path)))
-    _check(LIB, LIB.LGBM_BoosterFree(booster))
-    _check(LIB, LIB.LGBM_DatasetFree(train))
-    _check(LIB, LIB.LGBM_DatasetFree(test))
-
-    # reload + predict
-    booster2 = ctypes.c_void_p()
-    n_iters = ctypes.c_int(0)
-    _check(LIB, LIB.LGBM_BoosterCreateFromModelfile(
-        c_str(model_path), ctypes.byref(n_iters), ctypes.byref(booster2)))
-    assert n_iters.value == 30
-
-    flat = np.ascontiguousarray(Xte, np.float64)
-    preb = np.zeros(Xte.shape[0], dtype=np.float64)
-    num_preb = ctypes.c_int64(0)
-    _check(LIB, LIB.LGBM_BoosterPredictForMat(
-        booster2, flat.ctypes.data_as(ctypes.c_void_p), dtype_float64,
-        ctypes.c_int32(Xte.shape[0]), ctypes.c_int32(Xte.shape[1]), 1,
-        0, -1, ctypes.byref(num_preb),
-        preb.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
-    assert num_preb.value == Xte.shape[0]
-    assert 0.0 <= preb.min() and preb.max() <= 1.0
-
-    # parity vs the python surface on the same model
-    import lightgbm_tpu as lgb
-    pyb = lgb.Booster(model_file=model_path)
-    np.testing.assert_allclose(preb, pyb.predict(Xte), rtol=1e-10)
-
-    # file predict
-    out_path = str(tmp_path / "preb.txt")
-    _check(LIB, LIB.LGBM_BoosterPredictForFile(
-        booster2, c_str(BINARY_TEST), 0, 0, -1, c_str(out_path)))
-    file_pred = np.loadtxt(out_path)
-    assert file_pred.shape[0] == Xte.shape[0]
-    np.testing.assert_allclose(file_pred, preb, atol=5e-6)
-
-    # leaf index predictions
-    n_pred = ctypes.c_int64(0)
-    _check(LIB, LIB.LGBM_BoosterCalcNumPredict(booster2, 5, 2, -1,
-                                               ctypes.byref(n_pred)))
-    leaves = np.zeros(int(n_pred.value), dtype=np.float64)
-    _check(LIB, LIB.LGBM_BoosterPredictForMat(
-        booster2, flat.ctypes.data_as(ctypes.c_void_p), dtype_float64,
-        ctypes.c_int32(5), ctypes.c_int32(Xte.shape[1]), 1,
-        2, -1, ctypes.byref(num_preb),
-        leaves.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
-    assert num_preb.value == 5 * 30
-    assert np.all(leaves >= 0) and np.all(leaves < 15)
-    _check(LIB, LIB.LGBM_BoosterFree(booster2))
-
-
-def test_error_reporting(LIB):
-    handle = ctypes.c_void_p()
-    ret = LIB.LGBM_DatasetCreateFromFile(
-        c_str("/nonexistent/file.txt"), c_str(""), None, ctypes.byref(handle))
-    assert ret == -1
-    assert b"" != LIB.LGBM_GetLastError()
-
-
-def test_push_rows_flow(LIB):
+def test_push_rows_flow(capi_results):
     """CreateFromSampledColumn + PushRows streaming construction
-    (c_api.cpp:341-415) must produce the same bins as CreateFromMat."""
-    rng = np.random.RandomState(7)
-    X = rng.normal(size=(400, 3)).astype(np.float64)
-    y = (X[:, 0] > 0).astype(np.float32)
+    (c_api.cpp:341-415) produces the same bins as CreateFromMat."""
+    _scenario(capi_results, "push_rows")
 
-    cols = [np.ascontiguousarray(X[:, i]) for i in range(3)]
-    col_ptrs = (ctypes.POINTER(ctypes.c_double) * 3)(
-        *[c.ctypes.data_as(ctypes.POINTER(ctypes.c_double)) for c in cols])
-    idxs = [np.arange(400, dtype=np.int32) for _ in range(3)]
-    idx_ptrs = (ctypes.POINTER(ctypes.c_int) * 3)(
-        *[i.ctypes.data_as(ctypes.POINTER(ctypes.c_int)) for i in idxs])
-    num_per_col = (ctypes.c_int * 3)(400, 400, 400)
 
-    handle = ctypes.c_void_p()
-    _check(LIB, LIB.LGBM_DatasetCreateFromSampledColumn(
-        col_ptrs, idx_ptrs, ctypes.c_int32(3), num_per_col,
-        ctypes.c_int32(400), ctypes.c_int32(400),
-        c_str("max_bin=31 min_data_in_leaf=5"), ctypes.byref(handle)))
-    # push in two chunks
-    for start, stop in ((0, 250), (250, 400)):
-        chunk = np.ascontiguousarray(X[start:stop])
-        _check(LIB, LIB.LGBM_DatasetPushRows(
-            handle, chunk.ctypes.data_as(ctypes.c_void_p), dtype_float64,
-            ctypes.c_int32(stop - start), ctypes.c_int32(3),
-            ctypes.c_int32(start)))
-    _check(LIB, LIB.LGBM_DatasetSetField(
-        handle, c_str("label"), y.ctypes.data_as(ctypes.c_void_p),
-        ctypes.c_int(len(y)), dtype_float32))
+def test_dataset_file_mat_csr_csc(capi_results):
+    """Dataset creation from BINARY_TRAIN file / mat / CSR / CSC plus
+    save-binary round trip."""
+    _scenario(capi_results, "dataset_io")
 
-    direct = _mat_handle(LIB, X, y, "max_bin=31 min_data_in_leaf=5")
 
-    # verify by training boosters on both and comparing one iteration
-    b1 = ctypes.c_void_p()
-    b2 = ctypes.c_void_p()
-    params = "app=binary num_leaves=7 verbose=-1 min_data_in_leaf=5"
-    _check(LIB, LIB.LGBM_BoosterCreate(handle, c_str(params),
-                                       ctypes.byref(b1)))
-    _check(LIB, LIB.LGBM_BoosterCreate(direct, c_str(params),
-                                       ctypes.byref(b2)))
-    fin = ctypes.c_int(0)
-    for b in (b1, b2):
-        _check(LIB, LIB.LGBM_BoosterUpdateOneIter(b, ctypes.byref(fin)))
-    out = []
-    for b in (b1, b2):
-        pred = np.zeros(400, dtype=np.float64)
-        n = ctypes.c_int64(0)
-        _check(LIB, LIB.LGBM_BoosterPredictForMat(
-            b, X.ctypes.data_as(ctypes.c_void_p), dtype_float64,
-            ctypes.c_int32(400), ctypes.c_int32(3), 1, 1, -1,
-            ctypes.byref(n),
-            pred.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
-        out.append(pred)
-    np.testing.assert_allclose(out[0], out[1], rtol=1e-12)
-    _check(LIB, LIB.LGBM_BoosterFree(b1))
-    _check(LIB, LIB.LGBM_BoosterFree(b2))
-    _check(LIB, LIB.LGBM_DatasetFree(handle))
-    _check(LIB, LIB.LGBM_DatasetFree(direct))
+def test_booster_train_save_predict(capi_results):
+    """30-iteration train loop on BINARY_TRAIN with GetEval, model
+    save/reload, PredictForMat/ForFile, leaf-index predict, and parity
+    against the Python Booster surface."""
+    _scenario(capi_results, "train_predict")

@@ -1,0 +1,171 @@
+"""Compile ledger (lightgbm_tpu/obs/compile_ledger.py): instrumented
+jits count compiles exactly — cache hits record nothing, shape misses
+record one event with program name, abstract shapes, and seconds — the
+events feed the registry (compile_count / compile_seconds, rendered at
+/metrics), the JSONL sink, and the obs-report --compile section.
+
+Process-global state (registry + in-memory ledger) is asserted by DELTA
+so this file composes with the rest of the tier-1 run.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import compile_ledger
+
+
+@pytest.fixture
+def ledger_file(tmp_path, monkeypatch):
+    """Route the JSONL sink to a temp file for the duration of a test
+    via the env var (which wins inside ``configure`` — so an
+    engine.train call mid-test cannot clear it; configure is otherwise
+    authoritative per run)."""
+    path = tmp_path / "compile_ledger.jsonl"
+    monkeypatch.setenv(compile_ledger.ENV_PATH, str(path))
+    compile_ledger.configure()
+    yield path
+    monkeypatch.delenv(compile_ledger.ENV_PATH)
+    compile_ledger.configure()             # back to in-memory only
+
+
+def _deltas():
+    return (obs.get_counter("compile_count"),
+            (obs.get_histogram("compile_seconds") or {}).get("count", 0),
+            len(compile_ledger.events()))
+
+
+def test_cache_hit_vs_shape_miss_counting(ledger_file):
+    c0, h0, e0 = _deltas()
+    fn = obs.instrumented_jit(lambda x: x * 2 + 1, program="t_double")
+    fn(jnp.ones(4))                        # compile 1
+    fn(jnp.ones(4) * 3)                    # cache hit: same shape
+    fn(jnp.ones(4))                        # cache hit again
+    fn(jnp.ones(8))                        # compile 2: shape miss
+    c1, h1, e1 = _deltas()
+    assert c1 - c0 == 2
+    assert h1 - h0 == 2
+    assert e1 - e0 == 2
+    mine = compile_ledger.events()[e0:]
+    assert [e["program"] for e in mine] == ["t_double", "t_double"]
+    assert mine[0]["shapes"] == "f32[4]"
+    assert mine[1]["shapes"] == "f32[8]"
+    assert all(e["seconds"] > 0 for e in mine)
+    # per-program counter landed too
+    assert obs.get_counter("compile_count_t_double") >= 2
+
+
+def test_ledger_jsonl_roundtrip(ledger_file):
+    fn = obs.instrumented_jit(lambda x: x - 1, program="t_file")
+    fn(jnp.ones(3))
+    fn(jnp.ones(5))
+    evs = compile_ledger.read_ledger(str(ledger_file))
+    assert [e["program"] for e in evs] == ["t_file", "t_file"]
+    assert {e["shapes"] for e in evs} == {"f32[3]", "f32[5]"}
+    # every line is independently parseable (append-only, flushed)
+    with open(ledger_file) as fh:
+        for line in fh:
+            json.loads(line)
+
+
+def test_static_args_and_kwargs_in_shapes():
+    fn = obs.instrumented_jit(lambda x, n: x[:n].sum(), program="t_static",
+                              static_argnames=("n",))
+    e0 = len(compile_ledger.events())
+    fn(jnp.arange(6.0), n=3)
+    ev = compile_ledger.events()[e0]
+    assert "f32[6]" in ev["shapes"] and "3" in ev["shapes"]
+
+
+def test_nested_jit_calls_not_double_counted():
+    """An instrumented jit called while another jit traces it inlines —
+    it must NOT record a compile of its own."""
+    inner = obs.instrumented_jit(lambda x: x * 3, program="t_inner")
+    outer = obs.instrumented_jit(lambda x: inner(x) + 1, program="t_outer")
+    e0 = len(compile_ledger.events())
+    outer(jnp.ones(7))
+    progs = [e["program"] for e in compile_ledger.events()[e0:]]
+    assert progs == ["t_outer"]
+
+
+def test_training_populates_ledger(ledger_file):
+    """End to end: a warmed-then-rerun training session leaves a
+    populated ledger (every event has name, shapes, seconds) and re-runs
+    on identical shapes add nothing (acceptance criterion)."""
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(500, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+              "min_data_in_leaf": 20}
+    e0 = len(compile_ledger.events())
+    lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=3)
+    mine = compile_ledger.events()[e0:]
+    assert mine, "training compiled nothing according to the ledger"
+    assert {"train_step", "pack_words"} <= {e["program"] for e in mine}
+    for e in mine:
+        assert e["program"] and e["shapes"] and e["seconds"] > 0
+    # identical second run: the jit caches are warm per-instance only
+    # for the booster-owned jits, but module-level programs (bag_mask,
+    # grow via train_step closure) re-trace per closure — so assert the
+    # cheap invariant: the ledger file carries exactly the in-memory
+    # events appended since this test's file was installed
+    disk = compile_ledger.read_ledger(str(ledger_file))
+    assert [e["program"] for e in disk] == \
+        [e["program"] for e in compile_ledger.events()[e0:]]
+
+
+def test_counting_jit_feeds_ledger():
+    """serve/batcher.py CountingJit rides the shared detection: its
+    per-bucket counters AND the ledger record the same compile."""
+    import jax
+    from lightgbm_tpu.serve.batcher import CountingJit
+    cj = CountingJit(jax.jit(lambda x: x.sum(axis=0)), "t_bucketed")
+    c0 = obs.get_counter("t_bucketed_compiles")
+    e0 = len(compile_ledger.events())
+    cj(16, jnp.ones((16, 2)))
+    cj(16, jnp.ones((16, 2)))              # warm
+    cj(32, jnp.ones((32, 2)))
+    assert obs.get_counter("t_bucketed_compiles") - c0 == 2
+    assert obs.get_counter("t_bucketed_compiles_bucket_16") >= 1
+    assert obs.get_counter("t_bucketed_compiles_bucket_32") >= 1
+    progs = [e["program"] for e in compile_ledger.events()[e0:]]
+    assert progs == ["t_bucketed", "t_bucketed"]
+
+
+def test_compile_series_rendered_at_metrics():
+    """The ledger's registry series render in the Prometheus exposition
+    (what a /metrics scrape of a training run serves)."""
+    from lightgbm_tpu.obs import prom
+    fn = obs.instrumented_jit(lambda x: -x, program="t_prom")
+    fn(jnp.ones(2))
+    text = prom.render()
+    assert "lightgbm_tpu_compile_count " in text
+    assert "lightgbm_tpu_compile_seconds_bucket" in text
+    assert "lightgbm_tpu_compile_count_t_prom" in text
+    parsed = prom.parse_text(text)
+    hist = prom.histogram_series(parsed, "lightgbm_tpu_compile_seconds")
+    assert hist["count"] >= 1
+
+
+def test_obs_report_compile_section(tmp_path):
+    """obs-report --compile: totals, per-program seconds, slowest with
+    shapes."""
+    from lightgbm_tpu.obs.report import summarize_compile
+    path = tmp_path / "ledger.jsonl"
+    with open(path, "w") as fh:
+        for prog, shapes, sec in (("grow_tree", "u8[28,100]", 120.5),
+                                  ("grow_tree", "u8[28,200]", 60.25),
+                                  ("train_gradients", "f32[1,100]", 1.5)):
+            fh.write(json.dumps({"program": prog, "shapes": shapes,
+                                 "seconds": sec}) + "\n")
+    rep = summarize_compile(str(path), top_k=2)
+    assert rep["count"] == 3
+    assert rep["seconds_total"] == pytest.approx(182.25)
+    assert rep["programs"]["grow_tree"]["count"] == 2
+    assert rep["programs"]["grow_tree"]["seconds"] == pytest.approx(180.75)
+    assert rep["slowest"][0] == {"program": "grow_tree",
+                                 "shapes": "u8[28,100]", "seconds": 120.5}

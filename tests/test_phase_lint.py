@@ -29,6 +29,38 @@ def test_lint_recognizes_obs_span_sites():
     assert m and m.group(1) == "GBDT::tree"
 
 
+def test_lint_recognizes_trace_span_sites():
+    """The causal-tracing call forms (obs/tracing.py) count as phase
+    users too: a span name invented at a tracing call site must fail
+    the lint instead of minting an unregistered series."""
+    lint = _load_lint()
+    m = lint.SCOPE_RE.search('with obs.trace_span("Serve::request"):')
+    assert m and m.group(1) == "Serve::request"
+    m = lint.SCOPE_RE.search('obs.trace_begin("Serve::queue",')
+    assert m and m.group(1) == "Serve::queue"
+    m = lint.SCOPE_RE.search('with TRACER.span("GBDT::iteration"):')
+    assert m and m.group(1) == "GBDT::iteration"
+
+
+def test_lint_catches_undeclared_trace_span(tmp_path, monkeypatch):
+    """A tracing span name outside the taxonomy is a lint error."""
+    lint = _load_lint()
+    pkg = tmp_path / "lightgbm_tpu"
+    (pkg / "obs").mkdir(parents=True)
+    (pkg / "ops").mkdir()
+    real = (pathlib.Path(lint.__file__).resolve().parent.parent
+            / "lightgbm_tpu" / "obs" / "phases.py")
+    (pkg / "obs" / "phases.py").write_text(real.read_text())
+    (pkg / "server.py").write_text(
+        'with obs.trace_span("Serve::rogue"):\n    pass\n')
+    (pkg / "ops" / "grow.py").write_text("")
+    (pkg / "ops" / "ordered_grow.py").write_text("")
+    monkeypatch.setattr(lint, "ROOT", tmp_path)
+    monkeypatch.setattr(lint, "PKG", pkg)
+    errors = lint.check()
+    assert any("Serve::rogue" in e for e in errors)
+
+
 def test_every_phase_resolves_to_unique_span_series():
     """Check 4: the phase taxonomy maps 1:1 onto valid histogram series
     names, so the metrics namespace cannot diverge from phases.py."""

@@ -1,0 +1,337 @@
+"""Causal tracing (lightgbm_tpu/obs/tracing.py) and HBM memwatch
+(lightgbm_tpu/obs/memwatch.py):
+
+- Chrome trace export round-trip: emit -> parse -> validate parent/child
+  structure and trace-ID continuity through a coalesced MicroBatcher
+  batch (the many-to-one edge is explicit);
+- a serve HTTP round trip yields a Perfetto-loadable trace whose request
+  span tree links queue -> coalesced batch -> device predict (acceptance
+  criterion);
+- training gets one trace per boosting round for free via obs.span;
+- memwatch gauges appear in a /metrics scrape when enabled.
+
+The tracer is process-global: every test arms it against a temp path and
+disarms + clears in a fixture so this file composes with the tier-1 run.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import memwatch, tracing
+
+
+@pytest.fixture
+def tracer(tmp_path, monkeypatch):
+    """Arm the process tracer via the env var (which wins inside
+    ``configure`` — so an engine.train call mid-test keeps it armed;
+    configure is otherwise authoritative per run)."""
+    path = tmp_path / "trace_events.json"
+    tracing.TRACER.reset()
+    monkeypatch.setenv(tracing.ENV_PATH, str(path))
+    tracing.TRACER.configure()
+    yield path
+    tracing.TRACER.disable()
+    tracing.TRACER.reset()
+    tracing.TRACER.path = None
+
+
+def _train(n=400, rounds=3):
+    rng = np.random.RandomState(5)
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] + 0.2 * X[:, 1] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "min_data_in_leaf": 20},
+                    lgb.Dataset(X, label=y), num_boost_round=rounds)
+    return bst, X
+
+
+# ---------------------------------------------------------------------------
+# tracer core
+
+
+def test_span_tree_roundtrip(tracer):
+    with obs.trace_span("GBDT::iteration") as root:
+        with obs.trace_span("GBDT::tree") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+    with obs.trace_span("GBDT::iteration") as root2:
+        assert root2.trace_id != root.trace_id     # fresh root, fresh trace
+    out = tracing.TRACER.export()
+    assert out == str(tracer)
+    events = tracing.read_trace(out)
+    tree = tracing.span_trees(events)
+    assert len(tree["roots"]) == 2
+    assert len(tree["traces"]) == 2
+    r = next(s for s in tree["roots"]
+             if tree["children"].get(s))
+    (kid,) = tree["children"][r]
+    assert tree["spans"][kid]["name"] == "GBDT::tree"
+    # chrome-format invariants Perfetto relies on
+    for e in events:
+        if e.get("ph") == "X":
+            assert e["ts"] >= 0 and e["dur"] >= 0 and "pid" in e
+
+
+def test_configure_is_authoritative(tmp_path, monkeypatch):
+    """A run configured WITHOUT the switches disarms them — a second
+    engine.train in one process cannot inherit the previous run's
+    instrumentation (or keep appending to its files)."""
+    from lightgbm_tpu.obs import compile_ledger
+    monkeypatch.delenv(tracing.ENV_PATH, raising=False)
+    monkeypatch.delenv(compile_ledger.ENV_PATH, raising=False)
+    monkeypatch.delenv(memwatch.ENV, raising=False)
+    assert tracing.TRACER.configure(str(tmp_path / "t.json")) is True
+    assert tracing.TRACER.configure(None) is False
+    lpath = str(tmp_path / "l.jsonl")
+    assert compile_ledger.configure(lpath) == lpath
+    assert compile_ledger.configure(None) is None
+    assert memwatch.configure(True) is True
+    assert memwatch.configure(None) is False
+
+
+def test_disabled_tracer_is_inert():
+    assert not tracing.TRACER.enabled
+    with obs.trace_span("GBDT::iteration") as h:
+        assert h is None
+    assert obs.trace_begin("Serve::queue") is None
+    obs.trace_end(None)
+    obs.trace_link(None, None)
+    with obs.span("GBDT::iteration") as sp:
+        assert sp.trace is None
+
+
+def test_cross_thread_end_and_link(tracer):
+    """begin() in one thread, end()/link() in another — the batcher's
+    exact usage."""
+    q = obs.trace_begin("Serve::queue")
+
+    def worker():
+        with obs.trace_span("Serve::batch") as b:
+            obs.trace_link(q, b)
+            obs.trace_end(q)
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    tree = tracing.span_trees(tracing.TRACER.events())
+    batch = next(s for s, e in tree["spans"].items()
+                 if e["name"] == "Serve::batch")
+    queue = next(s for s, e in tree["spans"].items()
+                 if e["name"] == "Serve::queue")
+    assert tree["coalesced_into"][queue] == batch
+    assert tree["spans"][batch]["args"]["member_trace_ids"] == \
+        [tree["spans"][queue]["args"]["trace_id"]]
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher coalescing
+
+
+def test_microbatcher_coalesce_edges(tracer):
+    """Trace-ID continuity through a coalesced batch: N concurrent
+    requests -> one device batch, recorded as N explicit edges."""
+    from lightgbm_tpu.serve.batcher import MicroBatcher
+    release = threading.Event()
+
+    def predict_fn(rows):
+        return np.zeros((1, rows.shape[0]), np.float32)
+
+    mb = MicroBatcher(predict_fn, max_batch=64, max_delay_s=0.15)
+    results = []
+
+    def client(i):
+        with obs.trace_span("Serve::request", args={"request_id": i}):
+            release.wait(5.0)
+            results.append(mb.submit(np.ones((2, 3), np.float32)))
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.02)
+    release.set()
+    for t in threads:
+        t.join()
+    mb.close()
+    assert len(results) == 3
+
+    tree = tracing.span_trees(tracing.TRACER.events())
+    reqs = {s: e for s, e in tree["spans"].items()
+            if e["name"] == "Serve::request"}
+    queues = {s: e for s, e in tree["spans"].items()
+              if e["name"] == "Serve::queue"}
+    batches = {s: e for s, e in tree["spans"].items()
+               if e["name"] == "Serve::batch"}
+    assert len(reqs) == 3 and len(queues) == 3
+    # each queue span is the child of its request span, same trace
+    for qs, qe in queues.items():
+        parent = qe["args"]["parent_id"]
+        assert parent in reqs
+        assert qe["args"]["trace_id"] == \
+            reqs[parent]["args"]["trace_id"]
+        # and coalesces into some batch span
+        assert qs in tree["coalesced_into"]
+        assert tree["coalesced_into"][qs] in batches
+    # all three rode batches whose member lists cover every request trace
+    member_traces = set()
+    for be in batches.values():
+        member_traces.update(be["args"].get("member_trace_ids", []))
+    assert member_traces == {e["args"]["trace_id"] for e in reqs.values()}
+    # the device predict is a child of a batch span
+    preds = [e for e in tree["spans"].values()
+             if e["name"] == "Predict::forest"]
+    assert preds and all(p["args"]["parent_id"] in batches for p in preds)
+
+
+def test_shed_request_closes_queue_span(tracer):
+    """A request shed on timeout still closes its queue span (marked
+    shed) — unfinished spans would silently vanish from the export."""
+    from lightgbm_tpu.serve.batcher import MicroBatcher
+    gate = threading.Event()
+
+    def slow_predict(rows):
+        gate.wait(3.0)
+        return np.zeros((1, rows.shape[0]), np.float32)
+
+    mb = MicroBatcher(slow_predict, max_batch=2, max_delay_s=2.0)
+    # first request opens a batch window the worker sits in; the second
+    # stays queued past its timeout and is shed
+    t1 = threading.Thread(
+        target=lambda: mb.submit(np.ones((2, 2), np.float32), timeout=5.0))
+    t1.start()
+    time.sleep(0.05)
+    with pytest.raises(TimeoutError):
+        mb.submit(np.ones((1, 2), np.float32), timeout=0.05)
+    gate.set()
+    t1.join()
+    mb.close()
+    shed = [e for e in tracing.TRACER.events()
+            if e.get("ph") == "X" and e["name"] == "Serve::queue"
+            and (e.get("args") or {}).get("shed")]
+    assert len(shed) == 1
+
+
+# ---------------------------------------------------------------------------
+# serve HTTP round trip (acceptance criterion)
+
+
+def test_http_round_trip_trace(tracer, tmp_path):
+    from lightgbm_tpu.serve import CompiledForest, PredictServer
+    bst, X = _train()
+    forest = CompiledForest.from_booster(bst, buckets=[16, 64]).warmup()
+    srv = PredictServer(forest, port=0, max_delay_ms=30.0).start()
+    host, port = srv.address
+
+    def post():
+        body = json.dumps({"rows": X[:3].tolist()}).encode()
+        req = urllib.request.Request(
+            f"http://{host}:{port}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            assert r.headers.get("X-Request-Id")
+            assert json.loads(r.read())["num_rows"] == 3
+
+    threads = [threading.Thread(target=post) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    srv.stop()                 # exports the trace on shutdown
+
+    events = tracing.read_trace(str(tracer))
+    tree = tracing.span_trees(events)
+    reqs = [s for s, e in tree["spans"].items()
+            if e["name"] == "Serve::request"]
+    assert len(reqs) == 2
+    for r in reqs:
+        assert tree["spans"][r]["args"]["request_id"]
+        # request -> queue
+        kids = [tree["spans"][k]["name"]
+                for k in tree["children"].get(r, [])]
+        assert "Serve::queue" in kids
+        # queue -> coalesced batch -> device predict (critical path
+        # walks the coalesce edge)
+        names = [s["name"] for s in tracing.critical_path(tree, r)]
+        assert names[:2] == ["Serve::request", "Serve::queue"]
+        assert "Serve::batch" in names and "Predict::forest" in names
+
+
+# ---------------------------------------------------------------------------
+# training: one trace per boosting round
+
+
+def test_training_rounds_are_traces(tracer):
+    # engine.train exports at exit AND clears the buffer (one export
+    # per run), so the assertion reads the exported file
+    _train(rounds=4)
+    assert not tracing.TRACER.events()
+    tree = tracing.span_trees(tracing.read_trace(str(tracer)))
+    iters = [s for s, e in tree["spans"].items()
+             if e["name"] == "GBDT::iteration"]
+    assert len(iters) == 4
+    # each round is its own root with its own trace id
+    assert all(s in tree["roots"] for s in iters)
+    assert len({tree["spans"][s]["args"]["trace_id"]
+                for s in iters}) == 4
+
+
+def test_summarize_traces(tracer):
+    _train(rounds=3)                       # exports on engine exit
+    rep = tracing.summarize_traces([str(tracer)], top_k=2)
+    assert rep["traces"] >= 3
+    assert rep["roots"]["GBDT::iteration"]["count"] == 3
+    assert len(rep["slowest"]) == 2
+    assert rep["slowest"][0]["critical_path"][0]["name"] == \
+        "GBDT::iteration"
+
+
+# ---------------------------------------------------------------------------
+# memwatch
+
+
+@pytest.fixture
+def memwatch_on(monkeypatch):
+    monkeypatch.setenv(memwatch.ENV, "1")
+    memwatch.configure()
+    yield
+    memwatch.enable(False)
+
+
+def test_memwatch_gauges_in_metrics_scrape(memwatch_on):
+    import jax.numpy as jnp
+    from lightgbm_tpu.obs import prom
+    keep = jnp.ones((128, 8), jnp.float32)      # noqa: F841 - held live
+    with obs.span("GBDT::iteration"):
+        pass                                    # exit samples memwatch
+    live = obs.get_gauge("memwatch_live_bytes")
+    assert live is not None and live >= keep.nbytes
+    assert obs.get_gauge("memwatch_peak_live_bytes") >= live
+    assert obs.get_gauge(
+        "memwatch_live_bytes_gbdt_iteration") is not None
+    text = prom.render()
+    assert "lightgbm_tpu_memwatch_live_bytes " in text
+    assert "lightgbm_tpu_memwatch_live_bytes_gbdt_iteration " in text
+    prom.parse_text(text)                       # stays format-valid
+
+
+def test_memwatch_off_by_default_and_env_config(monkeypatch):
+    assert memwatch.ENABLED is False
+    assert memwatch.configure(None) is False    # nothing set -> stays off
+    assert memwatch.configure("true") is True   # param flag
+    memwatch.enable(False)
+    monkeypatch.setenv(memwatch.ENV, "1")
+    assert memwatch.configure(False) is True    # env wins over param
+    memwatch.enable(False)
+
+
+def test_memwatch_training_sample(memwatch_on):
+    """A real training run leaves per-phase watermarks behind (the span
+    hook fires on GBDT::iteration every round)."""
+    _train(rounds=2)
+    live = obs.get_gauge("memwatch_live_bytes_gbdt_iteration")
+    assert live is not None and live > 0

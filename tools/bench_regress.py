@@ -14,7 +14,18 @@ exits 0 when the candidate's ``value`` is within ``--threshold`` percent
 below the baseline (higher is always better here — both bench modes
 report rates), 1 on a regression, 2 on unreadable/mismatched inputs.
 The one-line JSON verdict on stdout carries both values and the delta so
-a CI log shows the numbers, not just the exit code.  Intended CI shape
+a CI log shows the numbers, not just the exit code.
+
+``--warmup-threshold <pct>`` additionally gates the WARMUP tax (the XLA
+compile seconds before the timed windows): the candidate's ``warmup_s``
+may exceed the baseline's by at most that many percent.  ``warmup_s``
+is a first-class BENCH JSON key since round 6; for older baselines the
+value is recovered from the ``warmup_s=...`` field of the driver
+envelope's tail comment.  Lower warmup is always fine — the gate is
+one-sided, like the throughput gate.  Mind that warmup variance dwarfs
+throughput variance (34-321 s across BENCH_r02-r05 for identical code:
+remote-AOT service load + persistent-cache hits); gate wide, or pin the
+environment first.  Intended CI shape
 once a TPU runner exists (docs/OBSERVABILITY.md §Benchmark regression
 gate):
 
@@ -32,6 +43,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 from typing import Any, Dict, Optional
 
@@ -39,34 +51,44 @@ from typing import Any, Dict, Optional
 def extract_result(path: str) -> Dict[str, Any]:
     """Load a bench result from either a bare bench.py JSON line or a
     driver envelope (``parsed`` field, or the last JSON object line of a
-    ``tail`` transcript)."""
+    ``tail`` transcript).  ``warmup_s`` is folded in from the tail's
+    ``warmup_s=...`` stderr comment when the result object itself does
+    not carry it (pre-round-6 BENCH files)."""
     with open(path) as fh:
         text = fh.read()
     obj = json.loads(text)
     if "value" in obj and "metric" in obj:
         return obj
-    if isinstance(obj.get("parsed"), dict) and "value" in obj["parsed"]:
-        return obj["parsed"]
-    tail = obj.get("tail", "")
     result: Optional[Dict[str, Any]] = None
-    for line in str(tail).splitlines():
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                cand = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if "value" in cand and "metric" in cand:
-                result = cand
+    if isinstance(obj.get("parsed"), dict) and "value" in obj["parsed"]:
+        result = dict(obj["parsed"])
+    tail = str(obj.get("tail", ""))
+    if result is None:
+        for line in tail.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    cand = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "value" in cand and "metric" in cand:
+                    result = cand
     if result is None:
         raise ValueError(f"{path}: no bench result object found")
+    if "warmup_s" not in result:
+        m = re.search(r"\bwarmup_s=([0-9]+(?:\.[0-9]+)?)", tail)
+        if m:
+            result["warmup_s"] = float(m.group(1))
     return result
 
 
 def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
-            threshold_pct: float) -> Dict[str, Any]:
+            threshold_pct: float,
+            warmup_threshold_pct: Optional[float] = None) -> Dict[str, Any]:
     """Verdict dict; ``ok`` is False when the candidate regressed more
-    than ``threshold_pct`` percent below the baseline value."""
+    than ``threshold_pct`` percent below the baseline value, or (with a
+    warmup threshold) when its warmup exceeds the baseline's by more
+    than ``warmup_threshold_pct`` percent."""
     if baseline.get("metric") != candidate.get("metric"):
         raise ValueError(
             f"metric mismatch: baseline {baseline.get('metric')!r} vs "
@@ -77,7 +99,7 @@ def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
     if base <= 0:
         raise ValueError(f"baseline value {base} is not a positive rate")
     delta_pct = (cand - base) / base * 100.0
-    return {
+    verdict = {
         "metric": baseline.get("metric"),
         "unit": baseline.get("unit"),
         "baseline": base,
@@ -86,6 +108,31 @@ def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
         "threshold_pct": float(threshold_pct),
         "ok": delta_pct >= -float(threshold_pct),
     }
+    if warmup_threshold_pct is not None:
+        wb = baseline.get("warmup_s")
+        wc = candidate.get("warmup_s")
+        if wb is None or wc is None:
+            # a warmup gate over sides that never measured warmup would
+            # silently pass forever — that is an input error, not a pass
+            missing = [side for side, w in (("baseline", wb),
+                                            ("candidate", wc)) if w is None]
+            raise ValueError(
+                f"--warmup-threshold given but {' and '.join(missing)} "
+                f"carr{'y' if len(missing) > 1 else 'ies'} no warmup_s "
+                f"(neither as a JSON key nor in the tail comment)")
+        wb, wc = float(wb), float(wc)
+        wdelta = ((wc - wb) / wb * 100.0) if wb > 0 else \
+            (0.0 if wc <= 0 else float("inf"))
+        verdict.update({
+            "warmup_baseline_s": wb,
+            "warmup_candidate_s": wc,
+            "warmup_delta_pct": round(wdelta, 3) if wdelta != float("inf")
+            else None,
+            "warmup_threshold_pct": float(warmup_threshold_pct),
+            "warmup_ok": wdelta <= float(warmup_threshold_pct),
+        })
+        verdict["ok"] = verdict["ok"] and verdict["warmup_ok"]
+    return verdict
 
 
 def main(argv=None) -> int:
@@ -98,18 +145,29 @@ def main(argv=None) -> int:
                     help="fresh bench.py output JSON to check")
     ap.add_argument("--threshold", type=float, default=5.0,
                     help="allowed regression in percent (default 5)")
+    ap.add_argument("--warmup-threshold", type=float, default=None,
+                    help="also gate warmup_s: allowed warmup INCREASE in "
+                         "percent over the baseline (off by default)")
     args = ap.parse_args(argv)
     try:
         verdict = compare(extract_result(args.baseline),
-                          extract_result(args.candidate), args.threshold)
+                          extract_result(args.candidate), args.threshold,
+                          warmup_threshold_pct=args.warmup_threshold)
     except (OSError, ValueError, KeyError, json.JSONDecodeError) as exc:
         print(f"bench_regress: {exc}", file=sys.stderr)
         return 2
     print(json.dumps(verdict))
     if not verdict["ok"]:
-        print(f"bench_regress: REGRESSION {verdict['delta_pct']:+.2f}% "
-              f"(threshold -{args.threshold:g}%) on {verdict['metric']}",
-              file=sys.stderr)
+        if not verdict.get("warmup_ok", True):
+            print(f"bench_regress: WARMUP REGRESSION "
+                  f"{verdict['warmup_candidate_s']:g}s vs baseline "
+                  f"{verdict['warmup_baseline_s']:g}s "
+                  f"(threshold +{args.warmup_threshold:g}%)",
+                  file=sys.stderr)
+        if verdict["delta_pct"] < -args.threshold:
+            print(f"bench_regress: REGRESSION {verdict['delta_pct']:+.2f}% "
+                  f"(threshold -{args.threshold:g}%) on {verdict['metric']}",
+                  file=sys.stderr)
         return 1
     return 0
 

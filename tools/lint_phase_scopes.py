@@ -25,7 +25,11 @@ attributed back to the host account) if both match the declarations in
 
 ``obs.span("X")`` sites count as host-phase users alongside
 ``timetag.scope("X")`` — the span API is the always-on successor and
-feeds the same phase account (obs/spans.py).
+feeds the same phase account (obs/spans.py).  So do the causal-tracing
+call forms (``obs.trace_span("X")`` / ``obs.trace_begin("X")``,
+obs/tracing.py): trace span names are the SAME taxonomy, so a name
+invented at a tracing call site fails here instead of minting an
+unregistered series.
 
 Runs standalone (``python tools/lint_phase_scopes.py``) and as a tier-1
 test (tests/test_phase_lint.py).  phases.py is loaded by file path so
@@ -44,7 +48,9 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent
 PKG = ROOT / "lightgbm_tpu"
 
 SCOPE_RE = re.compile(
-    r"(?:timetag\.scope|obs\.span|spans\.span)\(\s*[\"']([^\"']+)[\"']")
+    r"(?:timetag\.scope|obs\.span|spans\.span"
+    r"|obs\.trace_span|obs\.trace_begin|tracing\.span|TRACER\.(?:span|begin)"
+    r")\(\s*[\"']([^\"']+)[\"']")
 NAMED_RE = re.compile(r"jax\.named_scope\(\s*[\"']([^\"']+)[\"']")
 SERIES_RE = re.compile(r"^phase_seconds_[a-z_][a-z0-9_]*$")
 
