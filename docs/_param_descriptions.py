@@ -12,9 +12,20 @@ DESC = {
     "num_leaves": "max leaves per tree (leaf-wise growth)",
     "tree_learner": "serial | feature | data | voting — distributed learner "
                     "over the device mesh",
-    "serial_grow": "ordered | cached — serial-learner strategy (leaf-ordered "
-                   "physical layout vs original-order cached learner; "
-                   "TPU-specific extension)",
+    "serial_grow": "ordered | cached | fused — serial-learner strategy "
+                   "(leaf-ordered physical layout, original-order cached "
+                   "learner, or full-pass growth through the fused "
+                   "histogram→split-gain kernel; TPU-specific extension)",
+    "compile_cache_dir": "persistent XLA compilation cache directory so "
+                         "repeated/resumed runs skip the warmup compile "
+                         "tax ('' = the /tmp default, 'off' disables; "
+                         "LIGHTGBM_TPU_COMPILE_CACHE env wins; "
+                         "docs/OBSERVABILITY.md §Warmup & compile caching)",
+    "row_buckets": "pad training rows up a shared shape ladder "
+                   "(utils/compile_cache.py bucket_rows; zero row_weight "
+                   "pad rows, exact histogram sums) so "
+                   "train_step/grow_tree programs are shared across "
+                   "nearby dataset sizes instead of compiling per N",
     "serve_host": "task=serve: HTTP bind address (docs/SERVING.md)",
     "serve_port": "task=serve: HTTP port",
     "serve_max_batch": "task=serve: row cap per coalesced device batch "

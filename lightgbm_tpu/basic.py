@@ -498,7 +498,8 @@ class Booster:
         like the reference (basic.py:1689)."""
         b = self._booster
         dd = b.train_data if data_idx == 0 else b.valid_data[data_idx - 1]
-        return np.asarray(dd.score, np.float64).reshape(-1)
+        # host_score crops the row-bucket pad (models/gbdt.py)
+        return dd.host_score().reshape(-1)
 
     def __eval_at(self, data_idx: int, name: str, feval=None):
         from .utils import timetag
@@ -508,7 +509,7 @@ class Booster:
                    else b.valid_metrics[data_idx - 1])
         dd = b.train_data if data_idx == 0 else b.valid_data[data_idx - 1]
         with timetag.scope("GBDT::metric"):
-            score = np.asarray(dd.score, np.float64)
+            score = dd.host_score()
             for m in metrics:
                 for mname, v in zip(m.names, m.eval(score)):
                     out.append((name, mname, v,

@@ -177,12 +177,14 @@ class _CBooster:
 
     def eval_at(self, data_idx: int) -> List[float]:
         b = self.b
+        # host_score crops the row-bucket pad (models/gbdt.py): metrics
+        # must see exactly num_data rows
         if data_idx == 0:
-            score = np.asarray(b.train_data.score, np.float64)
+            score = b.train_data.host_score()
             metrics = b.train_metrics
         else:
             dd = b.valid_data[data_idx - 1]
-            score = np.asarray(dd.score, np.float64)
+            score = dd.host_score()
             metrics = b.valid_metrics[data_idx - 1]
         out: List[float] = []
         for m in metrics:
@@ -194,7 +196,7 @@ class _CBooster:
         sigmoid output transform applied, class-major [num_class * n]."""
         b = self.b
         dd = b.train_data if data_idx == 0 else b.valid_data[data_idx - 1]
-        raw = np.asarray(dd.score, np.float64)
+        raw = dd.host_score()
         return np.asarray(b.objective.convert_output(raw)).reshape(-1)
 
     def n_pred_per_row(self, predict_type: int, num_iteration: int) -> int:

@@ -175,6 +175,11 @@ def main(argv=None) -> int:
     argv = ["task=serve" if tok == "serve" else tok for tok in argv]
     params = parse_cli_args(argv)
     config = Config(params)
+    # persistent XLA compile cache for EVERY task (train also re-applies
+    # inside engine.train; predict/serve only get it here): repeat CLI
+    # invocations start hot (utils/compile_cache.py)
+    from .utils import compile_cache
+    compile_cache.setup(config.compile_cache_dir or None)
     if config.task == "train":
         run_train(config, params)
     elif config.task in ("predict", "prediction", "test"):

@@ -110,8 +110,10 @@ _DEFAULTS: Dict[str, Any] = {
     # serial-learner strategy: "ordered" = leaf-ordered physical layout
     # (ops/ordered_grow.py, uint8 bins; >256-bin datasets fall back to
     # the cached learner with a log line); "cached" = original-order
-    # cached learner (ops/grow.py).  TPU-specific extension, not a
-    # reference parameter.
+    # cached learner (ops/grow.py); "fused" = full-pass growth through
+    # the fused histogram->split-gain kernel (ops/pallas_histogram.py,
+    # no per-leaf cache).  TPU-specific extension, not a reference
+    # parameter.
     "serial_grow": "ordered",
     "seed": 0,
     "num_threads": 0,
@@ -221,6 +223,14 @@ _DEFAULTS: Dict[str, Any] = {
     "trace_events_file": "",    # Chrome trace-event JSON export of the
                                 # causal span tree (LIGHTGBM_TPU_TRACE_EVENTS
                                 # env wins; load in Perfetto)
+    # warmup tax (utils/compile_cache.py; docs/OBSERVABILITY.md)
+    "compile_cache_dir": "",   # persistent XLA compile cache dir ("" = the
+                               # /tmp default, "off" disables;
+                               # LIGHTGBM_TPU_COMPILE_CACHE env wins)
+    "row_buckets": True,       # pad training rows up a shared shape ladder
+                               # (zero row_weight, bit-identical trees) so
+                               # train_step/grow_tree programs are shared
+                               # across nearby dataset sizes
 }
 
 _BOOL_KEYS = {k for k, v in _DEFAULTS.items() if isinstance(v, bool)}
@@ -346,7 +356,7 @@ class Config:
         v = self._values
         if v["tree_learner"] not in ("serial", "feature", "data", "voting"):
             raise ValueError(f"Unknown tree learner type {v['tree_learner']}")
-        if v["serial_grow"] not in ("ordered", "cached"):
+        if v["serial_grow"] not in ("ordered", "cached", "fused"):
             raise ValueError(
                 f"Unknown serial_grow strategy {v['serial_grow']}")
         if v["nan_policy"] not in ("none", "fail_fast", "skip_tree"):

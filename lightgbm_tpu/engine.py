@@ -50,6 +50,13 @@ def train(params, train_set, num_boost_round=100,
     checksum and fall back to the previous one."""
     params = dict(params or {})
     events_file = events_file or params.get("events_file") or None
+    # -- persistent XLA compile cache (utils/compile_cache.py): applied
+    # BEFORE any device work so the training programs themselves are
+    # covered — repeated/resumed runs load executables from disk instead
+    # of paying the 34-321 s warmup tax again.  On by default;
+    # compile_cache_dir=off disables, LIGHTGBM_TPU_COMPILE_CACHE wins.
+    from .utils import compile_cache as _compile_cache
+    _compile_cache.setup(params.get("compile_cache_dir") or None)
     # -- deep observability (lightgbm_tpu/obs/, docs/OBSERVABILITY.md):
     # compile ledger / HBM watermarks / causal trace export.  All off
     # unless configured; the matching env vars win inside configure().

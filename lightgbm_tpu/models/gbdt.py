@@ -25,10 +25,11 @@ from ..io.binning import CATEGORICAL
 from ..io.dataset import BinnedDataset
 from ..metric import Metric, create_metric
 from ..objective import ObjectiveFunction, create_objective
-from ..ops.grow import (GrowParams, grow_tree, pack_tree_arrays,
+from ..ops.grow import (GrowParams, SerialComm, grow_tree, pack_tree_arrays,
                         unpack_tree_arrays)
+from ..ops.ordered_grow import grow_tree_ordered, pack_u8_words
 from ..ops.predict import predict_binned_forest, predict_binned_tree
-from ..utils import log, timetag
+from ..utils import compile_cache, log, timetag
 from .tree import Tree
 
 
@@ -121,45 +122,70 @@ def _device_memory_limit() -> Optional[int]:
 
 class _DeviceData:
     """Device-resident binned dataset + per-dataset score buffer
-    (ScoreUpdater, score_updater.hpp:23-99)."""
+    (ScoreUpdater, score_updater.hpp:23-99).
+
+    ``padded_rows`` > num_data pads every row-dimension array up to a
+    shared shape bucket (utils/compile_cache.py bucket_rows): pad rows
+    carry bin 0, zero gradients (via zero ``row_weight``, exactly how
+    bagging excludes rows) and a score nobody reads — ``host_score``
+    crops them.  Histogram sums are EXACT (the digit path is int32 and
+    pad digits are zero), so splits match the unpadded run; only the f32
+    leaf-total reductions may re-associate across shapes, the same
+    last-bit wiggle any row-count change causes.  In exchange every
+    jitted training program is shared across nearby dataset sizes."""
 
     def __init__(self, dataset: BinnedDataset, num_models: int,
-                 with_row_major: bool = False):
+                 with_row_major: bool = False,
+                 padded_rows: Optional[int] = None):
         self.dataset = dataset
-        h2d_xfers, h2d_bytes = 1, int(dataset.bins.nbytes)
+        self.num_data = dataset.num_data
+        self.padded_rows = max(int(padded_rows or 0), dataset.num_data)
+        pad = self.padded_rows - dataset.num_data
+        bins_np = dataset.bins if pad == 0 else \
+            np.pad(dataset.bins, ((0, 0), (0, pad)))
+        h2d_xfers, h2d_bytes = 1, int(bins_np.nbytes)
         # Native uint8/uint16 on device (int32 would 4x the HBM footprint
         # and the histogram kernel's read traffic).
-        self.bins = jnp.asarray(dataset.bins)
+        self.bins = jnp.asarray(bins_np)
         # Row-major copy for the cached serial learner's leaf gathers
         # (ops/leafhist.py needs rows contiguous).
-        self.bins_rm = (jnp.asarray(np.ascontiguousarray(dataset.bins.T))
+        self.bins_rm = (jnp.asarray(np.ascontiguousarray(bins_np.T))
                         if with_row_major else None)
         if self.bins_rm is not None:
             h2d_xfers += 1
-            h2d_bytes += int(dataset.bins.nbytes)
+            h2d_bytes += int(bins_np.nbytes)
         # Word-packed payload lanes for the leaf-ordered grower, shared
         # across trees (uint8 bins only; uint16 routes to the cached
         # learner).
         self.bins_words = None
         if with_row_major and self.bins_rm is not None \
                 and self.bins_rm.dtype == jnp.uint8:
-            from ..ops.ordered_grow import pack_u8_words, _size_classes
-
-            pad = _size_classes(dataset.num_data)[-1]
-
-            @obs.instrumented_jit(program="pack_words")
-            def _pack_padded(rm):
-                return tuple(jnp.pad(w, (0, pad))
-                             for w in pack_u8_words(rm))
-            self.bins_words = _pack_padded(self.bins_rm)
-        self.num_data = dataset.num_data
-        init = np.zeros((num_models, self.num_data), np.float32)
+            from ..ops.ordered_grow import _size_classes
+            self.bins_words = _pack_words_padded(
+                self.bins_rm, _size_classes(self.padded_rows)[-1])
+        init = np.zeros((num_models, self.padded_rows), np.float32)
         if dataset.metadata.init_score is not None:
-            init += np.asarray(dataset.metadata.init_score,
-                               np.float32).reshape(num_models, self.num_data)
+            init[:, :self.num_data] += np.asarray(
+                dataset.metadata.init_score,
+                np.float32).reshape(num_models, self.num_data)
         self.score = jnp.asarray(init)
         obs.inc("host_to_device_transfers", h2d_xfers + 1)
         obs.inc("host_to_device_bytes", h2d_bytes + int(init.nbytes))
+
+    def host_score(self, dtype=np.float64) -> np.ndarray:
+        """[num_models, num_data] host copy of the score cache with the
+        row-bucket pad cropped — what metrics/snapshots/C-API readers
+        must consume instead of the raw (padded) device buffer."""
+        return np.asarray(self.score, dtype)[:, :self.num_data]
+
+    def set_score(self, score) -> None:
+        """Replace the score cache from a host array of real rows,
+        re-padding up to the bucket (snapshot restore)."""
+        score = np.asarray(score, np.float32)
+        if score.shape[-1] < self.padded_rows:
+            score = np.pad(score, ((0, 0),
+                                   (0, self.padded_rows - score.shape[-1])))
+        self.score = jnp.asarray(score)
 
     def add_tree(self, tree_arrays, is_cat, cls: int, max_steps: int):
         n = tree_arrays.split_feature.shape[0]
@@ -183,8 +209,9 @@ def _all_finite(*arrays):
     return ok
 
 
-@obs.instrumented_jit(program="bag_mask", static_argnames=("n", "bag_cnt"))
-def _device_bag_mask(key, n: int, bag_cnt: int):
+@obs.instrumented_jit(program="bag_mask",
+                      static_argnames=("n", "bag_cnt", "n_real"))
+def _device_bag_mask(key, n: int, bag_cnt: int, n_real: int = -1):
     """EXACT-count sample without replacement (reference bag_data_cnt_).
 
     Ranks rows by raw 32-bit random words with the row index as a total-
@@ -192,19 +219,160 @@ def _device_bag_mask(key, n: int, bag_cnt: int):
     kth order statistic collides with another row in roughly 1 of 8
     draws and a value-only threshold would keep bag_cnt+1 rows.  The
     (word, index) pair is unique, so exactly bag_cnt rows satisfy
-    pair <= pair_sorted[bag_cnt - 1]."""
+    pair <= pair_sorted[bag_cnt - 1].
+
+    ``n_real < n`` marks the tail as row-bucket padding
+    (utils/compile_cache.py): pad rows draw the max word, so every real
+    (word, index) pair sorts before them and the bag is drawn from real
+    rows only."""
     if bag_cnt <= 0:
         # matches the host-draw degenerate case (reference bag_data_cnt=0
         # keeps nothing); the wrapped [-1] index would keep EVERYTHING
         return jnp.zeros((n,), jnp.float32)
+    n_real = n if n_real < 0 else n_real
     r = jax.random.bits(key, (n,), jnp.uint32)
     iota = jnp.arange(n, dtype=jnp.int32)
+    if n_real < n:
+        r = jnp.where(iota < n_real, r, jnp.uint32(0xFFFFFFFF))
     r_sorted, i_sorted = jax.lax.sort((r, iota), num_keys=1,
                                       is_stable=True)
     thr_r = r_sorted[bag_cnt - 1]
     thr_i = i_sorted[bag_cnt - 1]
     keep = (r < thr_r) | ((r == thr_r) & (iota <= thr_i))
+    if n_real < n:
+        keep &= iota < n_real
     return keep.astype(jnp.float32)
+
+
+@obs.instrumented_jit(program="pack_words", static_argnames=("pad",))
+def _pack_words_padded(rm, pad: int):
+    """Word-pack a row-major bin matrix and pad each word lane by the
+    ordered grower's largest window class.  Module-level (pad is a
+    static argument, not a closure) so every booster over the same
+    shapes shares ONE compiled program."""
+    return tuple(jnp.pad(w, (0, pad)) for w in pack_u8_words(rm))
+
+
+_PACK_TREE = obs.instrumented_jit(pack_tree_arrays, program="pack_tree")
+
+
+def _donation_enabled() -> bool:
+    """Round-to-round buffer donation is gated to accelerator backends.
+    On this jax build XLA:CPU's input-output aliasing intermittently
+    corrupts donated buffers (freed-buffer reads that surface as
+    segfaults in LATER host conversions — reproduced in the round-7
+    suite by running training files together), and the double-allocation
+    donation avoids only matters for HBM-sized buffers anyway.
+    ``LIGHTGBM_TPU_DONATION`` (1/0) overrides for experiments."""
+    env = os.environ.get("LIGHTGBM_TPU_DONATION", "").strip().lower()
+    if env:
+        return env in ("1", "true", "yes", "on")
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:  # pragma: no cover - backend not initialized
+        return False
+
+
+@obs.instrumented_jit(program="score_update", static_argnames=("cls",),
+                      donate_argnums=(0,))
+def _score_add_donated(score, delta, cls: int):
+    """In-place (donated) per-class score update: XLA writes the new
+    score into the old buffer instead of double-allocating the
+    [num_class, N] cache every round.  Only used when nan_policy is off
+    (containment keeps a pre-iteration reference alive for rollback,
+    which donation would invalidate) AND _donation_enabled() says the
+    backend supports aliasing safely."""
+    return score.at[cls].add(delta)
+
+
+# ---------------------------------------------------------------------------
+# Process-wide training-program registry.
+#
+# Every GBDT instance used to build its own train_step/train_gradients
+# closures, capturing the dataset's bins/labels as compile-time
+# constants — so the SECOND same-config booster in a process (rebuilt
+# after snapshot-resume, a second engine.train call, the bench's warm
+# pass) re-traced and re-compiled everything from scratch.  With the
+# objective's functional-gradients interface every per-dataset array is
+# now a runtime ARGUMENT, so one traced program per (objective key,
+# class count, guard, grow strategy, grow params) serves every booster;
+# repeated runs hit the jit's executable cache and record ZERO new
+# train_step compiles in the ledger.
+
+_SHARED_JITS: Dict[tuple, Any] = {}
+
+# Entries retain only scalar-bearing objective HOLDERS (program_holder
+# strips the per-dataset arrays), so a cached program costs bytes, not a
+# dead dataset's HBM.  The cap is a leak backstop for pathological key
+# churn (legacy id-keyed objectives in a long sweep); eviction only
+# costs a recompile if that config returns.
+_SHARED_JITS_MAX = 64
+
+
+def _shared_jit(key: tuple, make, program: str, **jit_kwargs):
+    fn = _SHARED_JITS.get(key)
+    if fn is None:
+        while len(_SHARED_JITS) >= _SHARED_JITS_MAX:
+            _SHARED_JITS.pop(next(iter(_SHARED_JITS)))
+        fn = obs.instrumented_jit(make(), program=program, **jit_kwargs)
+        _SHARED_JITS[key] = fn
+    return fn
+
+
+def _shared_gradients_fn(objective):
+    """Shared jitted gradients program for this objective configuration
+    (arrays travel as arguments; scalars key the program)."""
+    holder = objective.program_holder()
+    return _shared_jit(("train_gradients", objective.program_key()),
+                       lambda: holder.gradients_with,
+                       program="train_gradients")
+
+
+def _build_shared_train_step(objective, num_class: int, guard: bool,
+                             kind: str, params: GrowParams):
+    """One fused boosting iteration as a PURE function of device arrays:
+    gradients -> per-class grow -> score update -> packed host vectors.
+    ``kind`` picks the serial growth strategy; the inner grow jits
+    inline under this trace (obs/compile_ledger.py passthrough)."""
+    fused_comm = SerialComm(leaf_cache=False, fused_gain=True)
+
+    def step_fn(score, feat_masks, row_weight, lr, bins, num_bin, is_cat,
+                grad_arrays, bins_rm, bins_words):
+        grad, hess = objective.gradients_with(grad_arrays, score)
+        ok = (_all_finite(grad, hess) if guard else jnp.asarray(True))
+        outs = []
+        for cls in range(num_class):
+            args = (bins, num_bin, is_cat, feat_masks[cls], grad[cls],
+                    hess[cls], row_weight, lr)
+            if kind == "ordered":
+                ta, _, delta = grow_tree_ordered(*args, params,
+                                                 bins_rm=bins_rm,
+                                                 bins_words=bins_words)
+            elif kind == "fused":
+                ta, _, delta = grow_tree(*args, params, fused_comm, bins_rm)
+            else:
+                ta, _, delta = grow_tree(*args, params, bins_rm=bins_rm)
+            score = score.at[cls].add(delta)
+            outs.append((pack_tree_arrays(ta), ta, delta))
+        return score, outs, ok
+    return step_fn
+
+
+def _shared_train_step(objective, num_class: int, guard: bool, kind: str,
+                       params: GrowParams, donate: bool):
+    key = ("train_step", objective.program_key(), num_class, guard, kind,
+           params, donate)
+    holder = objective.program_holder()
+    return _shared_jit(
+        key,
+        lambda: _build_shared_train_step(holder, num_class, guard,
+                                         kind, params),
+        program="train_step",
+        # round-to-round state donation: the score cache is the only
+        # argument that is dead after the call (the caller immediately
+        # rebinds it to the output), so XLA may update it in place
+        # instead of double-allocating [num_class, N] every iteration
+        donate_argnums=(0,) if donate else ())
 
 
 class GBDT:
@@ -269,9 +437,18 @@ class GBDT:
         self.grow_params = self._make_grow_params(cfg)
         self.shrinkage_rate = cfg.learning_rate
 
+        # shape-bucketed training rows (utils/compile_cache.py): nearby
+        # dataset sizes share one compiled train_step/grow program.
+        # Legacy custom objectives (pre-round-7 gradients() overrides)
+        # close over unpadded arrays, so they opt out.
+        self._padded_rows = (compile_cache.bucket_rows(self.num_data)
+                             if self._row_buckets_enabled(cfg)
+                             and not self.objective.uses_legacy_gradients()
+                             else self.num_data)
         self._check_memory_budget(cfg, train_set)
         self.train_data = _DeviceData(train_set, self.num_class,
-                                      with_row_major=True)
+                                      with_row_major=True,
+                                      padded_rows=self._padded_rows)
         self.valid_data: List[_DeviceData] = []
         self.valid_metrics: List[List[Metric]] = []
         self.train_metrics = self._make_metrics(cfg, train_set)
@@ -282,11 +459,9 @@ class GBDT:
         self._bag_cnt = self.num_data
         self._bag_key = jax.random.PRNGKey(cfg.bagging_seed)
         self._feature_rng = np.random.RandomState(cfg.feature_fraction_seed)
-        self._row_weight = jnp.ones(self.num_data, jnp.float32)
-        self._grad_fn = obs.instrumented_jit(self.objective.gradients,
-                                             program="train_gradients")
-        self._pack_fn = obs.instrumented_jit(pack_tree_arrays,
-                                             program="pack_tree")
+        self._init_row_state()
+        self._grad_arrays = self.objective.gradient_arrays(self._padded_rows)
+        self._grad_fn = self._make_grad_fn()
         self._grow_fn = self._make_grow_fn()
         # device-constant caches (avoid a host->device transfer per iter)
         self._full_feat_mask = jnp.ones(self.num_features, bool)
@@ -294,6 +469,51 @@ class GBDT:
                                          bool)
         self._lr_cache: Tuple[float, jax.Array] = (-1.0, jnp.float32(0))
         self._train_step = None
+
+    @staticmethod
+    def _row_buckets_enabled(cfg: Config) -> bool:
+        """Row-bucket padding applies to single-process serial training
+        only: the distributed learners shard rows across a device mesh
+        (ingest owns their layout), and multihost arrays are promoted
+        per process — padding either would change those invariants."""
+        if not bool(getattr(cfg, "row_buckets", True)):
+            return False
+        if getattr(cfg, "is_parallel", False):
+            return False
+        try:
+            if jax.process_count() > 1:
+                return False
+        except Exception:  # pragma: no cover - uninitialized backend
+            pass
+        return True
+
+    def _init_row_state(self) -> None:
+        """Row-dimension device state at the padded shape: the real-row
+        mask and the all-ones (real rows only) weight vector every
+        un-bagged iteration reuses."""
+        mask = np.zeros(self._padded_rows, bool)
+        mask[:self.num_data] = True
+        self._real_rows = jnp.asarray(mask)
+        self._ones_weight = jnp.asarray(mask.astype(np.float32))
+        self._row_weight = self._ones_weight
+
+    def _make_grad_fn(self):
+        """Per-booster binding of the SHARED gradients program: the
+        arrays travel per call, so a rebuilt booster (resume, second
+        run) reuses the compiled program instead of re-tracing one that
+        baked the previous dataset's labels in as constants."""
+        jit = _shared_gradients_fn(self.objective)
+        arrays = self._grad_arrays
+        return lambda score: jit(arrays, score)
+
+    def _serial_grow_kind(self) -> str:
+        cfg = self.config
+        if cfg.serial_grow == "fused":
+            return "fused"
+        if cfg.serial_grow == "ordered" \
+                and self.train_data.bins_words is not None:
+            return "ordered"
+        return "cached"
 
     def _check_memory_budget(self, cfg: Config,
                              train_set: BinnedDataset) -> None:
@@ -303,7 +523,8 @@ class GBDT:
         for an LRU bound the resident-cache design does not provide
         (reference feature_histogram.hpp:299-455)."""
         est = estimate_train_memory(
-            train_set.num_data, train_set.num_features, cfg.num_leaves,
+            getattr(self, "_padded_rows", train_set.num_data),
+            train_set.num_features, cfg.num_leaves,
             cfg.max_bin, self.num_class,
             bin_itemsize=train_set.bins.dtype.itemsize)
         obs.set_gauge("hbm_train_estimate_bytes", int(est["total"]))
@@ -376,6 +597,7 @@ class GBDT:
         cfg = self.config
         self._comm_traffic = None           # serial: no collectives
         self._comm_traffic_totals = (0, 0)
+        self._parallel_grow_active = False
         if getattr(cfg, "is_parallel", False):
             ndev = len(jax.devices())
             # single-controller-per-host: num_machines counts HOSTS (the
@@ -403,24 +625,31 @@ class GBDT:
                     # to global arrays / gather sharded outputs back
                     from ..parallel.multihost import globalize_grow_fn
                     fn = globalize_grow_fn(fn, mesh)
+                self._parallel_grow_active = True
                 return fn
             log.warning("tree_learner=%s requested but only %d device(s) "
                         "available; falling back to serial",
                         cfg.tree_learner, ndev)
         params = self.grow_params
         bins_rm = self.train_data.bins_rm
-        if (cfg.serial_grow == "ordered"
-                and self.train_data.bins_words is not None):
+        kind = self._serial_grow_kind()
+        if kind == "ordered":
             # leaf-ordered physical layout: partition cost ~ parent
             # segment, no gathers (ops/ordered_grow.py; exact-parity
             # tested against the unordered cached learner).  Its i32 lane
             # packing is uint8-only; >256-bin datasets use the cached
             # learner (logged so the throughput change is visible).
-            from ..ops.ordered_grow import grow_tree_ordered
             bins_words = self.train_data.bins_words
             return lambda *args: grow_tree_ordered(*args, params,
                                                    bins_rm=bins_rm,
                                                    bins_words=bins_words)
+        if kind == "fused":
+            # full-pass growth through the fused histogram->split-gain
+            # kernel (ops/pallas_histogram.py): both children's
+            # per-feature BestSplit candidates come straight out of the
+            # histogram pass — the [2, F, B, 3] tensor never lands in HBM
+            comm = SerialComm(leaf_cache=False, fused_gain=True)
+            return lambda *args: grow_tree(*args, params, comm, bins_rm)
         if cfg.serial_grow == "ordered":
             log.info("max_bin > 256: using the cached (original-order) "
                      "serial learner; the leaf-ordered fast path is "
@@ -471,17 +700,23 @@ class GBDT:
         self.objective.init(train_set.metadata, train_set.num_data)
         self.num_bin = jnp.asarray(train_set.num_bin_per_feature())
         self.is_cat = jnp.asarray(train_set.is_categorical_per_feature())
+        self._padded_rows = (compile_cache.bucket_rows(self.num_data)
+                             if self._row_buckets_enabled(cfg)
+                             and not self.objective.uses_legacy_gradients()
+                             else self.num_data)
         self.train_data = _DeviceData(train_set, self.num_class,
-                                      with_row_major=True)
+                                      with_row_major=True,
+                                      padded_rows=self._padded_rows)
         self.train_metrics = self._make_metrics(cfg, train_set)
-        self._row_weight = jnp.ones(self.num_data, jnp.float32)
+        self._init_row_state()
         self._full_feat_mask = jnp.ones(self.num_features, bool)
         self._full_feat_masks = jnp.ones((self.num_class, self.num_features),
                                          bool)
-        # a fresh jit: the old one captured the previous dataset's labels
-        # (objective.init state) as compile-time constants
-        self._grad_fn = obs.instrumented_jit(self.objective.gradients,
-                                             program="train_gradients")
+        # rebind the SHARED gradients program to this dataset's arrays
+        # (no retrace unless the shapes changed — the labels are runtime
+        # arguments now, not compile-time constants)
+        self._grad_arrays = self.objective.gradient_arrays(self._padded_rows)
+        self._grad_fn = self._make_grad_fn()
         self._grow_fn = self._make_grow_fn()
         self._train_step = None
         for i, tree in enumerate(self._models):
@@ -520,7 +755,11 @@ class GBDT:
                 getattr(self, "_train_mem_est", 0) / (1 << 20),
                 valid_bytes / (1 << 20))
         self._valid_mem_bytes = valid_bytes
-        dd = _DeviceData(valid_set, self.num_class)
+        dd = _DeviceData(valid_set, self.num_class,
+                         padded_rows=(
+                             compile_cache.bucket_rows(valid_set.num_data)
+                             if self._row_buckets_enabled(self.config)
+                             else valid_set.num_data))
         # replay existing trees (continued training)
         for i, tree in enumerate(self.models):
             cls = i % self.num_class
@@ -546,11 +785,12 @@ class GBDT:
         cfg = self.config
         if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
             self._bag_cnt = self.num_data
-            return jnp.ones(self.num_data, jnp.float32)
+            return self._ones_weight
         if iter_ % cfg.bagging_freq == 0:
             bag_cnt = int(cfg.bagging_fraction * self.num_data)
             self._bag_key, sub = jax.random.split(self._bag_key)
-            self._row_weight = _device_bag_mask(sub, self.num_data, bag_cnt)
+            self._row_weight = _device_bag_mask(sub, self._padded_rows,
+                                                bag_cnt, self.num_data)
             self._bag_cnt = bag_cnt
             obs.inc("bagging_draws")
         return self._row_weight
@@ -590,17 +830,45 @@ class GBDT:
         packed host transfer vectors.  A single device dispatch per
         iteration instead of ~5: each dispatch over the remote axon link
         costs ~1-5 ms of submit latency, which at >10 iters/sec is a
-        first-order cost (docs/BENCH_NOTES_r03.md)."""
-        grow = self._grow_fn
-        obj_grad = self.objective.gradients
-        bins, num_bin, is_cat = (self.train_data.bins, self.num_bin,
-                                 self.is_cat)
-        num_class = self.num_class
+        first-order cost (docs/BENCH_NOTES_r03.md).
+
+        Serial growth binds the process-wide SHARED train_step program
+        (every per-dataset array is an argument), so a rebuilt booster —
+        snapshot resume, a second run in the same process — reuses the
+        compiled program: zero new train_step compiles in the ledger.
+        The score argument is DONATED when nan_policy is off and the
+        backend is an accelerator (_donation_enabled), so XLA updates
+        the [num_class, N] cache in place instead of double-allocating
+        it every round."""
         # NaN/Inf containment: the grad/hess finiteness reduction runs
         # INSIDE the fused jit (the gradients never visit the host), so
         # the guarded path pays one extra scalar in the transfer — the
         # ungated path compiles the check away entirely.
         guard = self._nan_policy != "none"
+        if self._parallel_grow_active:
+            return self._make_train_step_local(guard)
+        jit = _shared_train_step(self.objective, self.num_class, guard,
+                                 self._serial_grow_kind(), self.grow_params,
+                                 donate=not guard and _donation_enabled())
+        td = self.train_data
+        bins, bins_rm, bins_words = td.bins, td.bins_rm, td.bins_words
+        num_bin, is_cat = self.num_bin, self.is_cat
+        grad_arrays = self._grad_arrays
+
+        def step(score, feat_masks, row_weight, lr):
+            return jit(score, feat_masks, row_weight, lr, bins, num_bin,
+                       is_cat, grad_arrays, bins_rm, bins_words)
+        return step
+
+    def _make_train_step_local(self, guard: bool):
+        """Per-booster fused step for the distributed learners: their
+        grow fn closes over a device mesh (shard_map), which the shared
+        registry cannot key portably."""
+        grow = self._grow_fn
+        obj_grad = self._grad_fn
+        bins, num_bin, is_cat = (self.train_data.bins, self.num_bin,
+                                 self.is_cat)
+        num_class = self.num_class
 
         @obs.instrumented_jit(program="train_step")
         def step_fn(score, feat_masks, row_weight, lr):
@@ -780,6 +1048,9 @@ class GBDT:
         # poisoned iteration rolls back by reassignment, no arithmetic
         # undo (which NaN would defeat: x + NaN - NaN != x).
         guard = self._nan_policy != "none"
+        # one donation decision per round: rollback references and the
+        # backend gate both veto in-place score updates
+        donate = not guard and _donation_enabled()
         poisoned = None               # which check tripped, for diagnostics
         if guard:
             score0 = self.train_data.score
@@ -809,7 +1080,8 @@ class GBDT:
                     with timetag.scope("GBDT::valid_score") as tt:
                         for dd in self.valid_data:
                             vd = self._device_tree_delta(dd, tree_arrays)
-                            dd.score = dd.score.at[cls].add(vd)
+                            dd.score = self._score_add(dd.score, vd,
+                                                       cls, donate)
                             vdeltas.append(vd)
                         tt.sync(vdeltas)
                     cur.append((packed, delta, vdeltas))
@@ -826,6 +1098,12 @@ class GBDT:
                         self.num_class, -1)
                     hess = jnp.asarray(hess, jnp.float32).reshape(
                         self.num_class, -1)
+                    if grad.shape[1] < self._padded_rows:
+                        # host fobj gradients cover the REAL rows; pad up
+                        # to the shared row bucket (the pad's zero
+                        # row_weight keeps it out of every tree)
+                        w = ((0, 0), (0, self._padded_rows - grad.shape[1]))
+                        grad, hess = jnp.pad(grad, w), jnp.pad(hess, w)
                     # GOSS-style subclasses sample/amplify host-provided
                     # gradients too (the reference Bagging step is
                     # objective-agnostic)
@@ -846,17 +1124,18 @@ class GBDT:
                         feat_mask, grad[cls], hess[cls], row_weight, lr_dev)
                     tt.sync(delta)
                 with timetag.scope("GBDT::train_score") as tt:
-                    self.train_data.score = \
-                        self.train_data.score.at[cls].add(delta)
+                    self.train_data.score = self._score_add(
+                        self.train_data.score, delta, cls, donate)
                     tt.sync(self.train_data.score)
                 vdeltas = []
                 with timetag.scope("GBDT::valid_score") as tt:
                     for dd in self.valid_data:
                         vd = self._device_tree_delta(dd, tree_arrays)
-                        dd.score = dd.score.at[cls].add(vd)
+                        dd.score = self._score_add(dd.score, vd, cls,
+                                                   donate)
                         vdeltas.append(vd)
                     tt.sync(vdeltas)
-                cur.append((self._pack_fn(tree_arrays), delta, vdeltas))
+                cur.append((_PACK_TREE(tree_arrays), delta, vdeltas))
             if guard and poisoned is None \
                     and not bool(_all_finite(self.train_data.score)):
                 # finite gradients can still yield a non-finite tree
@@ -908,6 +1187,14 @@ class GBDT:
         self._pending_shrinkage = shrink
         self._note_iter_event(it, t_iter0, tt0)
         return False
+
+    @staticmethod
+    def _score_add(score, delta, cls: int, donate: bool):
+        """Per-class score update; donated (in-place for XLA) unless a
+        NaN-containment rollback reference must stay alive."""
+        if donate:
+            return _score_add_donated(score, delta, cls)
+        return score.at[cls].add(delta)
 
     def _contain_poisoned_iter(self, it: int, what: str, score0,
                                vscores0) -> bool:
@@ -995,11 +1282,14 @@ class GBDT:
             "best_msg": dict(self.best_msg),
             "shrinkage_rate": float(self.shrinkage_rate),
             "no_more_splits": bool(self._no_more_splits),
-            "train_score": np.asarray(self.train_data.score),
-            "valid_scores": [np.asarray(dd.score)
+            # saved at the REAL row count (row-bucket pad cropped): the
+            # pad region is derived state nobody reads, and cropping
+            # keeps snapshots portable across row_buckets settings
+            "train_score": self.train_data.host_score(np.float32),
+            "valid_scores": [dd.host_score(np.float32)
                              for dd in self.valid_data],
             "bag_key": np.asarray(self._bag_key),
-            "row_weight": np.asarray(self._row_weight),
+            "row_weight": np.asarray(self._row_weight)[:self.num_data],
             "bag_cnt": int(self._bag_cnt),
             "feature_rng": self._feature_rng.get_state(),
             "cum_comm": (int(self._cum_comm_calls),
@@ -1040,17 +1330,22 @@ class GBDT:
         self.best_msg = dict(state["best_msg"])
         self.shrinkage_rate = float(state["shrinkage_rate"])
         self._no_more_splits = bool(state["no_more_splits"])
-        self.train_data.score = jnp.asarray(state["train_score"])
+        self.train_data.set_score(state["train_score"])
         saved_valid = state.get("valid_scores", [])
         for vi, dd in enumerate(self.valid_data):
-            if vi < len(saved_valid) and \
-                    np.shape(saved_valid[vi]) == np.shape(dd.score):
-                dd.score = jnp.asarray(saved_valid[vi])
+            saved = saved_valid[vi] if vi < len(saved_valid) else None
+            if saved is not None and np.shape(saved)[0] == self.num_class \
+                    and np.shape(saved)[-1] in (dd.num_data,
+                                                dd.padded_rows):
+                dd.set_score(np.asarray(saved)[:, :dd.num_data])
             else:
                 for i, tree in enumerate(self._models):
                     self._add_host_tree_to(dd, tree, i % self.num_class)
         self._bag_key = jnp.asarray(state["bag_key"], jnp.uint32)
-        self._row_weight = jnp.asarray(state["row_weight"], jnp.float32)
+        rw = np.zeros(self._padded_rows, np.float32)
+        saved_rw = np.asarray(state["row_weight"], np.float32)
+        rw[:min(len(saved_rw), self.num_data)] = saved_rw[:self.num_data]
+        self._row_weight = jnp.asarray(rw)
         self._bag_cnt = int(state["bag_cnt"])
         self._feature_rng.set_state(state["feature_rng"])
         self._cum_comm_calls, self._cum_comm_bytes = \
@@ -1095,7 +1390,7 @@ class GBDT:
         out_lines = []
         if cfg.is_training_metric and self.train_metrics:
             with timetag.scope("GBDT::metric"):
-                score = np.asarray(self.train_data.score, np.float64)
+                score = self.train_data.host_score()
                 for m in self.train_metrics:
                     for name, v in zip(m.names, m.eval(score)):
                         out_lines.append(
@@ -1103,7 +1398,7 @@ class GBDT:
         stop = False
         for vi, (dd, metrics) in enumerate(zip(self.valid_data,
                                                self.valid_metrics)):
-            score = np.asarray(dd.score, np.float64)
+            score = dd.host_score()
             for mi, m in enumerate(metrics):
                 values = m.eval(score)
                 for name, v in zip(m.names, values):
@@ -1134,7 +1429,7 @@ class GBDT:
     def _eval_metrics_impl(self) -> Dict[str, Dict[str, float]]:
         out: Dict[str, Dict[str, float]] = {}
         if self.train_metrics:
-            score = np.asarray(self.train_data.score, np.float64)
+            score = self.train_data.host_score()
             out["training"] = {}
             for m in self.train_metrics:
                 for name, v in zip(m.names, m.eval(score)):
@@ -1142,7 +1437,7 @@ class GBDT:
         for vi, (dd, metrics) in enumerate(zip(self.valid_data,
                                                self.valid_metrics)):
             key = f"valid_{vi + 1}"
-            score = np.asarray(dd.score, np.float64)
+            score = dd.host_score()
             out[key] = {}
             for m in metrics:
                 for name, v in zip(m.names, m.eval(score)):

@@ -46,7 +46,8 @@ class GOSS(GBDT):
     def _transform_host_gradients(self, grad, hess):
         warmup = int(1.0 / max(self.config.learning_rate, 1e-12))
         if self.iter_ < warmup:
-            self._row_weight = jnp.ones(self.num_data, jnp.float32)
+            # all real rows active (row-bucket pad rows stay at weight 0)
+            self._row_weight = self._ones_weight
             self._bag_cnt = self.num_data
             return grad, hess
         mask, grad, hess = self._sample(grad, hess)
@@ -85,23 +86,32 @@ class GOSS(GBDT):
         top_cnt = int(self.top_rate * n)
         other_cnt = int(self.other_rate * n)
         if top_cnt + other_cnt >= n or top_cnt == 0:
-            ones = jnp.ones(n, jnp.float32)
-            return ones, grad, hess
+            return self._ones_weight, grad, hess
+        # gradients arrive at the padded row-bucket shape; pad rows must
+        # never be drawn (their gradients are real numbers computed off a
+        # zero label), so they rank at -inf and are masked from the
+        # random keep below.  Direct callers (tests) may pass bare [K, N]
+        # gradients — bring them up to the bucket first.
+        np_rows = self._padded_rows
+        if grad.shape[1] < np_rows:
+            w = ((0, 0), (0, np_rows - grad.shape[1]))
+            grad, hess = jnp.pad(grad, w), jnp.pad(hess, w)
         # |g * h| summed over classes (goss.hpp:90: multiclass sums classes)
         score = jnp.abs(grad * hess).sum(axis=0)
+        score = jnp.where(self._real_rows, score, -jnp.inf)
         # EXACTLY top_cnt rows kept (ArgMaxAtK, goss.hpp:79-124): rank by
         # score with row index as the tie-break, not a >= threshold test —
         # low-entropy gradients (many equal |g*h|) would otherwise keep
         # every tie of the top_cnt-th score and overshoot a*N
         # (round-2 VERDICT weak #8).
         order = jnp.argsort(-score, stable=True)
-        rank = jnp.zeros(n, jnp.int32).at[order].set(
-            jnp.arange(n, dtype=jnp.int32), unique_indices=True)
+        rank = jnp.zeros(np_rows, jnp.int32).at[order].set(
+            jnp.arange(np_rows, dtype=jnp.int32), unique_indices=True)
         self._goss_key, sub = jax.random.split(self._goss_key)
-        rand = jax.random.uniform(sub, (n,))
+        rand = jax.random.uniform(sub, (np_rows,))
         keep_prob = self.other_rate / max(1e-12, 1.0 - self.top_rate)
         is_top = rank < top_cnt
-        is_other_kept = (~is_top) & (rand < keep_prob)
+        is_other_kept = (~is_top) & (rand < keep_prob) & self._real_rows
         mask = (is_top | is_other_kept).astype(jnp.float32)
         amp = (1.0 - self.top_rate) / max(self.other_rate, 1e-12)
         factor = jnp.where(is_other_kept, amp, 1.0)

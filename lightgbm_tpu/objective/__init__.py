@@ -22,8 +22,40 @@ from ..utils import log
 from ..io.dataset import Metadata
 
 
+def _pad_rows(arr, num_rows: Optional[int]):
+    """Pad a row-aligned [N] / [..., N] array with zeros up to num_rows
+    (the shared row-bucket shape, utils/compile_cache.py bucket_rows).
+    Zero labels/weights on pad rows are harmless: tree growth multiplies
+    every padded row's gradients by its zero ``row_weight``."""
+    if arr is None or num_rows is None:
+        return arr
+    n = arr.shape[-1]
+    if num_rows <= n:
+        return arr
+    return jnp.pad(arr, [(0, 0)] * (arr.ndim - 1) + [(0, num_rows - n)])
+
+
 class ObjectiveFunction:
-    """Base: subclasses define gradients(score[K,N]) -> (grad[K,N], hess[K,N])."""
+    """Base: subclasses define the gradient math over score[K,N].
+
+    Two call forms:
+
+    - ``gradients(score)`` — the historical entry point, closing over
+      this instance's dataset arrays (label, weights, ...).
+    - ``gradients_with(arrays, score)`` — the FUNCTIONAL form: every
+      per-dataset array travels as an argument (the pytree built by
+      ``gradient_arrays()``), and the method reads only scalar
+      parameters off ``self``.  This is what lets ``models/gbdt.py``
+      share ONE jitted gradient/train-step program across boosters: two
+      same-config runs hash to the same ``program_key()``, reuse the
+      same traced program, and feed it their own arrays — zero
+      recompiles on the second run instead of a fresh XLA program per
+      booster (the labels used to be baked in as compile-time
+      constants).
+
+    Subclasses implement ``gradients_with`` and extend
+    ``gradient_arrays``/``program_key`` when they carry extra state.
+    """
 
     name = "none"
     num_tree_per_iteration = 1
@@ -36,13 +68,80 @@ class ObjectiveFunction:
         self.weights = (None if metadata.weights is None
                         else jnp.asarray(metadata.weights, jnp.float32))
 
-    def gradients(self, score: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    # -- functional gradient interface ---------------------------------
+    def gradient_arrays(self, num_rows: Optional[int] = None) -> dict:
+        """Pytree of the per-dataset arrays ``gradients_with`` consumes,
+        row-aligned arrays zero-padded to ``num_rows`` (the shared row
+        bucket) when given."""
+        if self.uses_legacy_gradients():
+            # legacy subclasses carry their state in closures; nothing
+            # to thread through the argument pytree
+            return {}
+        return {"label": _pad_rows(self.label, num_rows),
+                "weights": _pad_rows(self.weights, num_rows)}
+
+    def uses_legacy_gradients(self) -> bool:
+        """True for subclasses written against the pre-round-7 contract:
+        they override ``gradients`` but not ``gradients_with``, so their
+        gradient math closes over instance state and cannot join the
+        shared-program registry (or the row-bucket padding, which would
+        feed them padded scores their captured arrays don't match)."""
+        cls = type(self)
+        return (cls.gradients is not ObjectiveFunction.gradients
+                and cls.gradients_with is ObjectiveFunction.gradients_with)
+
+    def program_key(self) -> tuple:
+        """Hashable fingerprint of everything ``gradients_with`` bakes
+        into its traced program BESIDES the argument arrays (scalar
+        hyper-parameters, data-derived scalars).  Two objectives with
+        equal keys may share one jitted program."""
+        if self.uses_legacy_gradients():
+            # instance-specific closure state: never share across
+            # instances (matches the pre-round-7 one-jit-per-booster
+            # behavior for custom objective subclasses)
+            return (type(self).__name__, id(self))
+        return (type(self).__name__,)
+
+    # instance attrs that hold per-dataset (O(num_data)) arrays; dropped
+    # by program_holder so the process-wide jit registry retains only
+    # scalars, not a dead dataset's device memory
+    _ARRAY_ATTRS = ("label", "weights", "label_int", "label_pos_weights",
+                    "query_classes", "discounts", "label_gain_j")
+
+    def program_holder(self) -> "ObjectiveFunction":
+        """The object the shared-program registry may retain for process
+        lifetime: a shallow copy with every per-dataset array attribute
+        removed (``gradients_with`` must read arrays from its argument
+        pytree only — a stripped holder turns a violation into a loud
+        AttributeError instead of silently pinning HBM).  Legacy
+        subclasses (``uses_legacy_gradients``) are returned as-is; their
+        id-based program_key already scopes them to this instance."""
+        if self.uses_legacy_gradients():
+            return self
+        import copy
+        holder = copy.copy(self)
+        for attr in self._ARRAY_ATTRS:
+            if attr in holder.__dict__:
+                del holder.__dict__[attr]
+        return holder
+
+    def gradients_with(self, arrays: dict, score: jax.Array
+                       ) -> Tuple[jax.Array, jax.Array]:
+        if self.uses_legacy_gradients():
+            # pre-round-7 custom subclass: route through its gradients()
+            # (closure state and all; arrays argument unused)
+            return self.gradients(score)
         raise NotImplementedError
 
-    def _apply_weight(self, grad, hess):
-        if self.weights is None:
+    def gradients(self, score: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        return self.gradients_with(self.gradient_arrays(), score)
+
+    @staticmethod
+    def _apply_weight(arrays, grad, hess):
+        w = arrays.get("weights")
+        if w is None:
             return grad, hess
-        return grad * self.weights, hess * self.weights
+        return grad * w, hess * w
 
     def convert_output(self, score: np.ndarray) -> np.ndarray:
         """Raw score -> prediction transform (GBDT::Predict, gbdt.cpp:799-815)."""
@@ -56,10 +155,10 @@ class RegressionL2Loss(ObjectiveFunction):
     """g = score - label, h = 1 (regression_objective.hpp:25-53)."""
     name = "regression"
 
-    def gradients(self, score):
-        g = score[0] - self.label
+    def gradients_with(self, arrays, score):
+        g = score[0] - arrays["label"]
         h = jnp.ones_like(g)
-        g, h = self._apply_weight(g, h)
+        g, h = self._apply_weight(arrays, g, h)
         return g[None], h[None]
 
 
@@ -79,12 +178,16 @@ class RegressionL1Loss(ObjectiveFunction):
     def __init__(self, config):
         self.eta = float(config.gaussian_eta)
 
-    def gradients(self, score):
+    def program_key(self):
+        return (type(self).__name__, self.eta)
+
+    def gradients_with(self, arrays, score):
         s = score[0]
-        w = self.weights if self.weights is not None else jnp.ones_like(s)
-        diff = s - self.label
+        label, weights = arrays["label"], arrays["weights"]
+        w = weights if weights is not None else jnp.ones_like(s)
+        diff = s - label
         g = jnp.where(diff >= 0.0, w, -w)
-        h = _gaussian_hessian(s, self.label, g, self.eta, w)
+        h = _gaussian_hessian(s, label, g, self.eta, w)
         return g[None], h[None]
 
 
@@ -97,15 +200,19 @@ class RegressionHuberLoss(ObjectiveFunction):
         self.delta = float(config.huber_delta)
         self.eta = float(config.gaussian_eta)
 
-    def gradients(self, score):
+    def program_key(self):
+        return (type(self).__name__, self.delta, self.eta)
+
+    def gradients_with(self, arrays, score):
         s = score[0]
-        w = self.weights if self.weights is not None else jnp.ones_like(s)
-        diff = s - self.label
+        label, weights = arrays["label"], arrays["weights"]
+        w = weights if weights is not None else jnp.ones_like(s)
+        diff = s - label
         inside = jnp.abs(diff) <= self.delta
         g_in = diff * w
         g_out = jnp.where(diff >= 0.0, self.delta * w, -self.delta * w)
         g = jnp.where(inside, g_in, g_out)
-        h_out = _gaussian_hessian(s, self.label, g_out, self.eta, w)
+        h_out = _gaussian_hessian(s, label, g_out, self.eta, w)
         h = jnp.where(inside, w, h_out)
         return g[None], h[None]
 
@@ -117,12 +224,15 @@ class RegressionFairLoss(ObjectiveFunction):
     def __init__(self, config):
         self.c = float(config.fair_c)
 
-    def gradients(self, score):
-        x = score[0] - self.label
+    def program_key(self):
+        return (type(self).__name__, self.c)
+
+    def gradients_with(self, arrays, score):
+        x = score[0] - arrays["label"]
         c = self.c
         g = c * x / (jnp.abs(x) + c)
         h = c * c / ((jnp.abs(x) + c) ** 2)
-        g, h = self._apply_weight(g, h)
+        g, h = self._apply_weight(arrays, g, h)
         return g[None], h[None]
 
 
@@ -134,11 +244,14 @@ class RegressionPoissonLoss(ObjectiveFunction):
     def __init__(self, config):
         self.max_delta_step = float(config.poisson_max_delta_step)
 
-    def gradients(self, score):
+    def program_key(self):
+        return (type(self).__name__, self.max_delta_step)
+
+    def gradients_with(self, arrays, score):
         s = score[0]
-        g = s - self.label
+        g = s - arrays["label"]
         h = s + self.max_delta_step
-        g, h = self._apply_weight(g, h)
+        g, h = self._apply_weight(arrays, g, h)
         return g[None], h[None]
 
 
@@ -172,9 +285,15 @@ class BinaryLogloss(ObjectiveFunction):
         self.label_weight_pos = w_pos
         self.label_weight_neg = w_neg
 
-    def gradients(self, score):
+    def program_key(self):
+        # label_weight_pos/neg are data-derived SCALARS (class counts):
+        # they are baked into the traced program, so they must key it
+        return (type(self).__name__, self.sigmoid,
+                float(self.label_weight_pos), float(self.label_weight_neg))
+
+    def gradients_with(self, arrays, score):
         s = score[0]
-        is_pos = self.label > 0
+        is_pos = arrays["label"] > 0
         lbl = jnp.where(is_pos, 1.0, -1.0)
         lw = jnp.where(is_pos, self.label_weight_pos, self.label_weight_neg)
         sig = self.sigmoid
@@ -182,7 +301,7 @@ class BinaryLogloss(ObjectiveFunction):
         abs_resp = jnp.abs(response)
         g = response * lw
         h = abs_resp * (sig - abs_resp) * lw
-        g, h = self._apply_weight(g, h)
+        g, h = self._apply_weight(arrays, g, h)
         return g[None], h[None]
 
     def convert_output(self, score):
@@ -211,17 +330,27 @@ class MulticlassLogloss(ObjectiveFunction):
             pos_w = ((num_data - cnts) / np.maximum(cnts, 1)).astype(np.float32)
         self.label_pos_weights = jnp.asarray(pos_w)
 
-    def gradients(self, score):
+    def gradient_arrays(self, num_rows=None):
+        arrays = super().gradient_arrays(num_rows)
+        arrays["label_int"] = _pad_rows(self.label_int, num_rows)
+        arrays["label_pos_weights"] = self.label_pos_weights
+        return arrays
+
+    def program_key(self):
+        return (type(self).__name__, self.num_class)
+
+    def gradients_with(self, arrays, score):
         # score: [K, N]
         p = jax.nn.softmax(score, axis=0)
         onehot = (jnp.arange(self.num_class, dtype=jnp.int32)[:, None]
-                  == self.label_int[None, :])
-        pw = self.label_pos_weights[:, None]
+                  == arrays["label_int"][None, :])
+        pw = arrays["label_pos_weights"][:, None]
         g = jnp.where(onehot, (p - 1.0) * pw, p)
         h = jnp.where(onehot, 2.0 * p * (1.0 - p) * pw, 2.0 * p * (1.0 - p))
-        if self.weights is not None:
-            g = g * self.weights[None, :]
-            h = h * self.weights[None, :]
+        weights = arrays["weights"]
+        if weights is not None:
+            g = g * weights[None, :]
+            h = h * weights[None, :]
         return g, h
 
     def convert_output(self, score):
@@ -311,19 +440,35 @@ class LambdarankNDCG(ObjectiveFunction):
                 "inv_max_dcg": jnp.asarray(inv_max_dcg, jnp.float32),
             })
 
-    def gradients(self, score):
+    def gradient_arrays(self, num_rows=None):
+        arrays = super().gradient_arrays(num_rows)
+        arrays["discounts"] = self.discounts
+        arrays["label_gain_j"] = self.label_gain_j
+        # per-size-class query tables WITHOUT the static pad size P —
+        # gradients_with recovers it from doc_idx.shape (static under
+        # trace), so the whole bundle travels as a plain arg pytree
+        arrays["classes"] = tuple(
+            {k: v for k, v in cls.items() if k != "P"}
+            for cls in self.query_classes)
+        return arrays
+
+    def program_key(self):
+        return (type(self).__name__, self.sigmoid, self.optimize_pos_at)
+
+    def gradients_with(self, arrays, score):
         s = jnp.asarray(score)[0]
         g = jnp.zeros_like(s)
         h = jnp.zeros_like(s)
-        for cls in self.query_classes:
-            g, h = self._class_gradients(s, cls, g, h)
-        if self.weights is not None:
-            g = g * self.weights
-            h = h * self.weights
+        for cls in arrays["classes"]:
+            g, h = self._class_gradients(arrays, s, cls, g, h)
+        weights = arrays["weights"]
+        if weights is not None:
+            g = g * weights
+            h = h * weights
         return g[None], h[None]
 
-    def _class_gradients(self, s, cls, g, h):
-        M = cls["P"]
+    def _class_gradients(self, arrays, s, cls, g, h):
+        M = cls["doc_idx"].shape[1]
 
         def one_query(args):
             doc_idx, valid, labels, inv_max_dcg = args
@@ -332,8 +477,8 @@ class LambdarankNDCG(ObjectiveFunction):
             sc_sorted = sc[order]
             lbl_sorted = labels[order]
             valid_sorted = valid[order]
-            gain_sorted = self.label_gain_j[lbl_sorted]
-            disc = self.discounts[:M]
+            gain_sorted = arrays["label_gain_j"][lbl_sorted]
+            disc = arrays["discounts"][:M]
             n_valid = valid.sum()
             best = sc_sorted[0]
             worst = sc_sorted[jnp.maximum(n_valid - 1, 0)]
@@ -398,7 +543,10 @@ class NoneObjective(ObjectiveFunction):
     def init(self, metadata, num_data):
         pass
 
-    def gradients(self, score):
+    def gradient_arrays(self, num_rows=None):
+        return {}
+
+    def gradients_with(self, arrays, score):
         raise RuntimeError(
             "objective=none requires a custom fobj passed to train()/update()")
 

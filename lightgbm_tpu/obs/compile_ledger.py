@@ -218,7 +218,12 @@ class InstrumentedJit:
         self.program = str(program)
         self._seen_keys: set = set()
 
-    # underlying-jit passthroughs (so stacked wrappers keep detecting)
+    # underlying-jit passthroughs (so stacked wrappers keep detecting,
+    # and callers can inspect the lowered program — e.g. the donation
+    # tests checking input/output buffer aliasing)
+    def lower(self, *args, **kwargs):
+        return self._fn.lower(*args, **kwargs)
+
     def _cache_size(self) -> Optional[int]:
         probe = getattr(self._fn, "_cache_size", None)
         if probe is None:
@@ -258,7 +263,10 @@ def instrumented_jit(fn: Optional[Callable] = None, *,
 
     Use as a decorator (``@instrumented_jit(program="grow_tree",
     static_argnames=("params",))``) or as a call
-    (``instrumented_jit(f, program="train_gradients")``).  A callable
+    (``instrumented_jit(f, program="train_gradients")``).  Every extra
+    kwarg reaches ``jax.jit`` unchanged — in particular
+    ``donate_argnums`` for round-to-round buffer donation (the shared
+    train_step donates its score argument; models/gbdt.py).  A callable
     that is already jitted (has ``lower``) is wrapped as-is — pass no
     extra jit kwargs in that case."""
     def wrap(f: Callable) -> InstrumentedJit:

@@ -73,8 +73,13 @@ def _size_classes(n: int, smallest: int = 8192):
 
     A x4-spaced ladder was tried for compile time and REVERTED: it saved
     no measurable warmup (remote-compile latency dominates and is now
-    hidden by the persistent compilation cache, bench.py) but cost ~5%
-    throughput in sort padding (docs/BENCH_NOTES_r03.md)."""
+    hidden by the persistent compilation cache, utils/compile_cache.py —
+    applied by every entry point since round 7, not just bench.py) but
+    cost ~5% throughput in sort padding (docs/BENCH_NOTES_r03.md).
+
+    Callers pass the row-BUCKETED N (utils/compile_cache.py
+    bucket_rows via models/gbdt.py), so the classes — and with them the
+    whole grow program — are shared across nearby dataset sizes."""
     out = []
     s = smallest
     while s < n:
@@ -129,7 +134,14 @@ def grow_tree_ordered(bins, num_bin, is_cat, feat_mask, grad, hess,
     Args/returns: see grow_tree.  ``bins_rm`` ([N, F] row-major) feeds the
     root histogram; ``bins_words`` (tuple of ceil(F/4) [N] i32 arrays from
     pack_u8_words, shared across trees) seeds the physical layout —
-    derived from bins_rm when omitted."""
+    derived from bins_rm when omitted.
+
+    N here may be the row-BUCKET shape (models/gbdt.py pads every row
+    array up the shared ladder): pad rows carry bin 0, zero digits and
+    zero ``row_weight``, so they ride the partition sorts inside
+    segments without touching any histogram sum or weighted count —
+    exactly like bagged-out rows — and ``compact_inactive`` moves them
+    behind the active segment together with the bagging zeros."""
     L = params.num_leaves
     B = params.max_bin
     F, N = bins.shape

@@ -1,0 +1,119 @@
+"""Persistent XLA compilation cache + shared training row buckets.
+
+BENCH_r02-r05 measured 34-321 s of XLA compiles per training run for
+IDENTICAL code — the warmup tax the compile ledger (obs/compile_ledger.py)
+made attributable in round 6.  Two levers kill most of it, both owned
+here so every entry point (engine.train, the CLI, bench.py's two modes)
+configures them identically instead of copy-pasting ``jax.config.update``
+blocks:
+
+- ``setup()`` points JAX's persistent compilation cache at a directory,
+  so a repeated or resumed run loads compiled executables from disk
+  instead of re-invoking XLA.  Precedence: the
+  ``LIGHTGBM_TPU_COMPILE_CACHE`` env var wins over the
+  ``compile_cache_dir`` config param, which wins over JAX's own
+  ``JAX_COMPILATION_CACHE_DIR``, which wins over the baked-in default
+  (``/tmp/lightgbm_tpu_jax_cache``).  The cache is ON by default — a
+  value of ``off``/``none``/``0`` disables it.
+
+- ``bucket_rows()`` maps a row count onto a small ladder of shared
+  shapes, the training-side counterpart of ``serve/batcher.py``'s
+  ``BucketLadder``: every jitted training program specializes on N, so
+  without bucketing each dataset size is a fresh compile of the most
+  expensive programs in the repo (``train_step``/``grow_tree``).
+  Training pads rows up to the bucket with zero ``row_weight`` (exactly
+  how bagging already excludes rows): histogram digit sums stay exact
+  (int32, pad digits zero) so splits match the unpadded run, and only
+  the f32 leaf-total reductions re-associate — the same last-bit wiggle
+  any row-count change causes.  In exchange nearby row counts share one
+  compiled program — in-process across boosters, and across processes
+  via the persistent cache.  The serve ladder's pure powers of
+  two would pad up to 2x; training rows are heavier than serve batches,
+  so this ladder keeps ``ROW_BUCKET_BITS`` mantissa bits (bucket =
+  next multiple of ``2^(bitlen(n-1) - bits)``), bounding pad overhead at
+  ``2^(1-bits)`` (6.25% worst case, ~1.6% typical, for the default 5
+  bits) while still collapsing the shape universe to ~32 buckets per
+  octave.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+ENV_DIR = "LIGHTGBM_TPU_COMPILE_CACHE"
+DEFAULT_CACHE_DIR = "/tmp/lightgbm_tpu_jax_cache"
+
+# Below this compile time XLA skips the disk write; 1.0 s keeps every
+# program that meaningfully contributes to the warmup tax (the default
+# of jax's flag misses mid-size programs that add up across a run).
+MIN_COMPILE_SECONDS = 1.0
+
+_OFF_VALUES = {"off", "none", "0", "false", "disabled"}
+
+# Last directory actually applied (None = disabled / never configured);
+# setup() is idempotent and cheap, so every entry point just calls it.
+_configured_dir: Optional[str] = None
+
+
+def resolve_dir(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Effective cache directory for a run, or None when disabled.
+
+    ``LIGHTGBM_TPU_COMPILE_CACHE`` env > ``cache_dir`` argument (the
+    ``compile_cache_dir`` param) > ``JAX_COMPILATION_CACHE_DIR`` env >
+    ``DEFAULT_CACHE_DIR``.  Any level may disable with an off-value."""
+    for value in (os.environ.get(ENV_DIR, ""),
+                  str(cache_dir or ""),
+                  os.environ.get("JAX_COMPILATION_CACHE_DIR", ""),
+                  DEFAULT_CACHE_DIR):
+        value = value.strip()
+        if not value:
+            continue
+        return None if value.lower() in _OFF_VALUES else value
+    return None  # pragma: no cover - DEFAULT_CACHE_DIR is never empty
+
+
+def setup(cache_dir: Optional[str] = None,
+          min_compile_seconds: float = MIN_COMPILE_SECONDS) -> Optional[str]:
+    """Configure JAX's persistent compilation cache; returns the
+    effective directory (None = disabled).  Idempotent — safe to call
+    from every entry point; must run before the first compilation to
+    cover it (later calls still cover later compiles)."""
+    global _configured_dir
+    path = resolve_dir(cache_dir)
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        if path is not None:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              float(min_compile_seconds))
+    except Exception as exc:  # pragma: no cover - jax without the flags
+        from . import log
+        log.warn_once("compile_cache_setup",
+                      "persistent compilation cache unavailable on this "
+                      "jax build (%s); every run pays full compiles", exc)
+        _configured_dir = None
+        return None
+    _configured_dir = path
+    return path
+
+
+def configured_dir() -> Optional[str]:
+    """Directory applied by the last setup() call (None = disabled)."""
+    return _configured_dir
+
+
+ROW_BUCKET_BITS = 5
+
+
+def bucket_rows(n: int, bits: int = ROW_BUCKET_BITS) -> int:
+    """Smallest shared-shape bucket >= n: the next multiple of
+    ``2^(bitlen(n-1) - bits)``.  Keeps ``bits`` mantissa bits, so pad
+    overhead is bounded by ``2^(1-bits)`` (6.25% worst case at the
+    default 5) and all row counts in an octave collapse onto at most
+    ``2^bits`` shapes."""
+    n = int(n)
+    if n <= 1:
+        return max(n, 0)
+    step = 1 << max((n - 1).bit_length() - int(bits), 0)
+    return -(-n // step) * step

@@ -56,7 +56,8 @@ def test_dart_scores_consistent_with_model(xgboost_mode):
     X, _ = _small_ds()
     for _ in range(8):
         b.train_one_iter()
-    score = np.asarray(b.train_data.score)[0]
+    # host_score crops the row-bucket pad (models/gbdt.py)
+    score = b.train_data.host_score()[0]
     pred = b.predict_raw(X)[0]
     np.testing.assert_allclose(score, pred, rtol=1e-4, atol=1e-5)
 

@@ -1,0 +1,221 @@
+"""CPU-interpreter parity of the fused histogram->split-gain kernel.
+
+The fused kernel (ops/pallas_histogram.py fused_children_split_candidates
+_pallas) must produce EXACTLY the BestSplit the two-program path does —
+same Pallas histogram accumulation, then per_feature_scan inside the
+kernel epilogue instead of a separate program over the [2, F, B, 3]
+tensor in HBM.  Both paths run the identical scan code (ops/split.py),
+so agreement is bit-for-bit, and these tests pin it across numerical and
+categorical features and the constraint edge cases (min_data_in_leaf,
+lambda_l1, min_sum_hessian, min_gain_to_split, masked features).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from lightgbm_tpu.ops.histogram import (build_children_histograms,  # noqa: E402
+                                        children_split_candidates)
+from lightgbm_tpu.ops.pallas_histogram import (  # noqa: E402
+    children_histograms_pallas, fused_children_split_candidates_pallas)
+from lightgbm_tpu.ops.split import (BestSplit, FeatureCandidates,  # noqa: E402
+                                    SplitParams, combine_feature_candidates,
+                                    find_best_split, per_feature_candidates)
+
+N_BLK = 256  # small kernel blocks: interpreter speed
+
+
+def _scenario(seed=0, n=700, f=6, max_bin=21, n_cat=2):
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, max_bin, size=(f, n)).astype(np.uint8)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = rng.uniform(0.2, 1.5, size=n).astype(np.float32)
+    weight = (rng.uniform(size=n) > 0.25).astype(np.float32)
+    leaf_id = rng.randint(0, 3, size=n).astype(np.int32)  # leaves 0,1,2
+    num_bin = rng.randint(2, max_bin + 1, size=f).astype(np.int32)
+    is_cat = np.zeros(f, bool)
+    is_cat[:n_cat] = True
+    feat_mask = np.ones(f, bool)
+    return (jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.asarray(weight), jnp.asarray(leaf_id), jnp.asarray(num_bin),
+            jnp.asarray(is_cat), jnp.asarray(feat_mask))
+
+
+def _totals(grad, hess, weight, leaf_id, parent, right):
+    g = grad * weight
+    h = hess * weight
+    out = []
+    for leaf in (parent, right):
+        m = (leaf_id == leaf).astype(jnp.float32)
+        out.append([float(jnp.sum(g * m)), float(jnp.sum(h * m)),
+                    float(jnp.sum(weight * m))])
+    return jnp.asarray(out, jnp.float32)
+
+
+def _both_paths(scn, max_bin, sp, parent=0, right=1, can=(True, True)):
+    """(reference BestSplit, fused BestSplit) for one scenario."""
+    bins, grad, hess, weight, leaf_id, num_bin, is_cat, feat_mask = scn
+    totals = _totals(grad, hess, weight, leaf_id, parent, right)
+    can = jnp.asarray(can)
+
+    hist = children_histograms_pallas(bins, grad, hess, weight, leaf_id,
+                                      parent, right, max_bin, n_blk=N_BLK,
+                                      interpret=True)
+    ref = find_best_split(hist, totals[:, 0], totals[:, 1], totals[:, 2],
+                          num_bin, is_cat, feat_mask, can, sp)
+
+    raw = fused_children_split_candidates_pallas(
+        bins, grad, hess, weight, leaf_id, parent, right, totals,
+        num_bin, is_cat, feat_mask, max_bin, sp, n_blk=N_BLK,
+        interpret=True)
+    cand = FeatureCandidates(gain=raw[:, :, 0],
+                             threshold=raw[:, :, 1].astype(jnp.int32),
+                             left_g=raw[:, :, 2], left_h=raw[:, :, 3],
+                             left_c=raw[:, :, 4])
+    fused = combine_feature_candidates(cand, totals[:, 0], totals[:, 1],
+                                       can, sp)
+    return ref, fused
+
+
+def _assert_split_equal(ref: BestSplit, fused: BestSplit):
+    np.testing.assert_array_equal(np.asarray(ref.gain),
+                                  np.asarray(fused.gain))
+    np.testing.assert_array_equal(np.asarray(ref.feature),
+                                  np.asarray(fused.feature))
+    np.testing.assert_array_equal(np.asarray(ref.threshold),
+                                  np.asarray(fused.threshold))
+    # left sums are meaningful only for splittable leaves (neither path
+    # masks them; on an unsplittable leaf they are whatever the masked
+    # -inf argmax landed on, which may differ over the lane pad)
+    ok = np.isfinite(np.asarray(ref.gain))
+    for a, b in ((ref.left_sum_g, fused.left_sum_g),
+                 (ref.left_sum_h, fused.left_sum_h),
+                 (ref.left_count, fused.left_count)):
+        np.testing.assert_array_equal(np.asarray(a)[ok], np.asarray(b)[ok])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fused_matches_find_best_split(seed):
+    scn = _scenario(seed=seed)
+    sp = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3)
+    ref, fused = _both_paths(scn, max_bin=21, sp=sp)
+    assert np.isfinite(np.asarray(ref.gain)).any(), "degenerate scenario"
+    _assert_split_equal(ref, fused)
+
+
+def test_fused_matches_with_l1_and_min_gain():
+    scn = _scenario(seed=3, n=900, max_bin=17)
+    sp = SplitParams(min_data_in_leaf=10, min_sum_hessian_in_leaf=0.5,
+                     lambda_l1=0.3, lambda_l2=0.7, min_gain_to_split=0.05)
+    _assert_split_equal(*_both_paths(scn, max_bin=17, sp=sp))
+
+
+def test_fused_matches_min_data_edge():
+    """min_data_in_leaf near the leaf size: most candidates invalid, the
+    valid frontier decides — the exact region a masking bug would hit."""
+    scn = _scenario(seed=4, n=400)
+    sp = SplitParams(min_data_in_leaf=60, min_sum_hessian_in_leaf=10.0)
+    _assert_split_equal(*_both_paths(scn, max_bin=21, sp=sp))
+
+
+def test_fused_all_unsplittable():
+    """Impossible constraints: both paths must report -inf gain and the
+    masked sentinel feature/threshold."""
+    scn = _scenario(seed=5, n=300)
+    sp = SplitParams(min_data_in_leaf=10_000)
+    ref, fused = _both_paths(scn, max_bin=21, sp=sp)
+    assert not np.isfinite(np.asarray(ref.gain)).any()
+    np.testing.assert_array_equal(np.asarray(fused.gain),
+                                  np.asarray(ref.gain))
+    np.testing.assert_array_equal(np.asarray(fused.feature), [-1, -1])
+    np.testing.assert_array_equal(np.asarray(fused.threshold), [0, 0])
+
+
+def test_fused_respects_feature_mask_and_can_split():
+    bins, grad, hess, weight, leaf_id, num_bin, is_cat, _ = _scenario(seed=6)
+    fm = np.ones(bins.shape[0], bool)
+    fm[2:] = False                      # only features 0,1 usable
+    scn = (bins, grad, hess, weight, leaf_id, num_bin, is_cat,
+           jnp.asarray(fm))
+    sp = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3)
+    ref, fused = _both_paths(scn, max_bin=21, sp=sp, can=(True, False))
+    _assert_split_equal(ref, fused)
+    assert np.asarray(fused.feature)[0] in (-1, 0, 1)
+    assert np.asarray(fused.feature)[1] == -1  # can_split=False masks
+
+
+def test_categorical_one_vs_rest_semantics():
+    """A pure-categorical scenario where the winning one-vs-rest bin is
+    known: category 0 carries all the negative gradient mass."""
+    n, f, max_bin = 512, 2, 8
+    rng = np.random.RandomState(7)
+    cats = rng.randint(0, 4, size=n)
+    bins = np.stack([cats, rng.randint(0, max_bin, size=n)]).astype(np.uint8)
+    grad = np.where(cats == 0, -2.0, 1.0).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    weight = np.ones(n, np.float32)
+    leaf_id = np.zeros(n, np.int32)
+    num_bin = np.asarray([4, max_bin], np.int32)
+    is_cat = np.asarray([True, False])
+    scn = tuple(jnp.asarray(a) for a in
+                (bins, grad, hess, weight, leaf_id, num_bin, is_cat,
+                 np.ones(f, bool)))
+    sp = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3)
+    ref, fused = _both_paths(scn, max_bin=max_bin, sp=sp, parent=0, right=-2,
+                             can=(True, False))
+    _assert_split_equal(ref, fused)
+    assert int(np.asarray(fused.feature)[0]) == 0
+    assert int(np.asarray(fused.threshold)[0]) == 0  # "cat == 0 goes left"
+
+
+def test_cpu_dispatcher_matches_scatter_path():
+    """children_split_candidates off-TPU == scatter histogram + the
+    shared per-feature scan (identical code, pinned anyway so the
+    dispatcher cannot drift)."""
+    bins, grad, hess, weight, leaf_id, num_bin, is_cat, fm = _scenario(8)
+    sp = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3)
+    totals = _totals(grad, hess, weight, leaf_id, 0, 1)
+    cand = children_split_candidates(bins, grad, hess, weight, leaf_id,
+                                     0, 1, totals, num_bin, is_cat, fm,
+                                     21, sp)
+    hist = build_children_histograms(bins, grad, hess, weight, leaf_id,
+                                     0, 1, 21)
+    want = per_feature_candidates(hist, totals[:, 0], totals[:, 1],
+                                  totals[:, 2], num_bin, is_cat, fm, sp)
+    for a, b in zip(cand, want):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_grow_tree_fused_comm_matches_plain_full_pass():
+    """End to end: grow_tree with the fused-gain comm produces the same
+    tree as the plain full-pass comm (identical scatter histograms feed
+    both on CPU, and the gain math is shared)."""
+    from lightgbm_tpu.ops.grow import GrowParams, SerialComm, grow_tree
+
+    rng = np.random.RandomState(9)
+    n, f, max_bin = 800, 5, 15
+    bins = jnp.asarray(rng.randint(0, max_bin, size=(f, n)).astype(np.uint8))
+    grad = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    hess = jnp.asarray(rng.uniform(0.5, 1.5, size=n).astype(np.float32))
+    w = jnp.ones(n, jnp.float32)
+    num_bin = jnp.full(f, max_bin, jnp.int32)
+    is_cat = jnp.zeros(f, bool)
+    fm = jnp.ones(f, bool)
+    params = GrowParams(num_leaves=8, max_bin=max_bin, min_data_in_leaf=20,
+                        min_sum_hessian_in_leaf=1e-3)
+    args = (bins, num_bin, is_cat, fm, grad, hess, w, jnp.float32(0.1))
+    ta_plain, leaf_plain, delta_plain = grow_tree(
+        *args, params, SerialComm(leaf_cache=False))
+    ta_fused, leaf_fused, delta_fused = grow_tree(
+        *args, params, SerialComm(leaf_cache=False, fused_gain=True))
+    for a, b in zip(ta_plain, ta_fused):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(leaf_plain),
+                                  np.asarray(leaf_fused))
+    np.testing.assert_array_equal(np.asarray(delta_plain),
+                                  np.asarray(delta_fused))
+    assert int(ta_fused.num_leaves) > 1

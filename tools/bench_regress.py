@@ -17,11 +17,15 @@ The one-line JSON verdict on stdout carries both values and the delta so
 a CI log shows the numbers, not just the exit code.
 
 ``--warmup-threshold <pct>`` additionally gates the WARMUP tax (the XLA
-compile seconds before the timed windows): the candidate's ``warmup_s``
-may exceed the baseline's by at most that many percent.  ``warmup_s``
-is a first-class BENCH JSON key since round 6; for older baselines the
-value is recovered from the ``warmup_s=...`` field of the driver
-envelope's tail comment.  Lower warmup is always fine — the gate is
+compile seconds before the timed windows): the candidate's COLD warmup
+may exceed the baseline's by at most that many percent.  Since round 7
+bench.py splits warmup into ``warmup_cold_s`` (first boot, compiles) and
+``warmup_warm_s`` (second booster, compile caches hot); the gate reads
+``warmup_cold_s`` and falls back to ``warmup_s`` (always a cold number,
+first-class key since round 6) so pre-r07 baselines compare like with
+like; for even older baselines the value is recovered from the
+``warmup_s=...`` field of the driver envelope's tail comment.  The warm
+number rides along in the verdict uninspected.  Lower warmup is always fine — the gate is
 one-sided, like the throughput gate.  Mind that warmup variance dwarfs
 throughput variance (34-321 s across BENCH_r02-r05 for identical code:
 remote-AOT service load + persistent-cache hits); gate wide, or pin the
@@ -109,8 +113,13 @@ def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
         "ok": delta_pct >= -float(threshold_pct),
     }
     if warmup_threshold_pct is not None:
-        wb = baseline.get("warmup_s")
-        wc = candidate.get("warmup_s")
+        # round 7 split warmup into warmup_cold_s (first-boot compile
+        # tax) and warmup_warm_s (steady-state, compile caches hot); the
+        # gate compares COLD with cold — pre-r07 baselines carry only
+        # warmup_s, which was always a cold measurement, so falling back
+        # to it keeps the comparison like-with-like.
+        wb = baseline.get("warmup_cold_s", baseline.get("warmup_s"))
+        wc = candidate.get("warmup_cold_s", candidate.get("warmup_s"))
         if wb is None or wc is None:
             # a warmup gate over sides that never measured warmup would
             # silently pass forever — that is an input error, not a pass
@@ -131,6 +140,12 @@ def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
             "warmup_threshold_pct": float(warmup_threshold_pct),
             "warmup_ok": wdelta <= float(warmup_threshold_pct),
         })
+        # informational: the warm-restart warmup, when both sides have it
+        # (r07+); not gated — its whole point is to be near zero, and the
+        # cold gate already guards the compile tax
+        for side, obj in (("baseline", baseline), ("candidate", candidate)):
+            if obj.get("warmup_warm_s") is not None:
+                verdict[f"warmup_warm_{side}_s"] = float(obj["warmup_warm_s"])
         verdict["ok"] = verdict["ok"] and verdict["warmup_ok"]
     return verdict
 
