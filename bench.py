@@ -50,7 +50,77 @@ def make_higgs_like(num_data: int, num_features: int = 28, seed: int = 42):
     return X.astype(np.float64), y
 
 
-def predict_main() -> None:
+def _fleet_scaling(booster, X32: np.ndarray, concurrency: int) -> dict:
+    """``--concurrency N``: threaded closed-loop clients against the
+    serving fleet at every replica count 1..len(local_devices) — the
+    1->K scaling curve as numbers.  Per replica count: aggregate and
+    per-replica rows/sec, shed rate, client p50/p99.  On a CPU box,
+    XLA_FLAGS=--xla_force_host_platform_device_count=K simulates K
+    devices (docs/SERVING.md §Benchmark)."""
+    import threading
+
+    import jax
+    from lightgbm_tpu.serve.batcher import default_ladder
+    from lightgbm_tpu.serve.fleet import Fleet, Overloaded
+    from lightgbm_tpu.serve.forest import CompiledForest
+
+    batch = int(os.environ.get("BENCH_PREDICT_FLEET_BATCH", 1024))
+    calls = int(os.environ.get("BENCH_PREDICT_FLEET_CALLS", 30))
+    queue_depth = int(os.environ.get("BENCH_PREDICT_QUEUE_DEPTH", 128))
+    rows = X32.shape[0]
+    batch = min(batch, rows)
+    # a fleet-sized ladder: every replica warms it, so keep it at the
+    # client batch instead of the offline 65536 ladder
+    forest = CompiledForest.from_booster(
+        booster, buckets=default_ladder(16, batch))
+    devs = jax.local_devices()
+    out = {}
+    for R in range(1, len(devs) + 1):
+        fleet = Fleet.build(forest, devices=devs[:R], max_batch=batch,
+                            max_delay_s=0.002, max_queue=queue_depth)
+        lat: list = []
+        served = [0] * concurrency
+        shed = [0] * concurrency
+
+        def client(ci: int) -> None:
+            for i in range(calls):
+                off = ((i * concurrency + ci) * batch) \
+                    % max(rows - batch + 1, 1)
+                t0 = time.time()
+                try:
+                    fleet.submit(X32[off:off + batch], timeout=300.0)
+                except Overloaded:
+                    shed[ci] += 1
+                    continue
+                lat.append((time.time() - t0) * 1000.0)
+                served[ci] += batch
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(concurrency)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.time() - t0
+        per_replica = [
+            round(rep["requests"] * batch / wall, 1)
+            for rep in fleet.stats()["replicas"]]
+        fleet.close()
+        attempts = concurrency * calls
+        out[str(R)] = {
+            "rows_per_sec": round(sum(served) / wall, 1),
+            "per_replica_rows_per_sec": per_replica,
+            "shed_rate": round(sum(shed) / attempts, 4),
+            "p50_ms": round(float(np.percentile(lat, 50)), 3) if lat
+            else None,
+            "p99_ms": round(float(np.percentile(lat, 99)), 3) if lat
+            else None,
+        }
+    return out
+
+
+def predict_main(concurrency: int = 0) -> None:
     """--mode predict: serving throughput/latency benchmark.
 
     Trains a small forest at the reference operating point (63 leaves,
@@ -58,7 +128,9 @@ def predict_main() -> None:
     every bucket, then measures the fused device-binned predict path
     (the server hot path) per batch size.  One BENCH-style JSON line:
     rows/sec at the largest batch as the headline, per-batch-size
-    rows/sec + p50/p99 call latency in ``batches``."""
+    rows/sec + p50/p99 call latency in ``batches``.  With
+    ``--concurrency N`` the JSON gains a ``fleet`` block: closed-loop
+    clients against 1..K device replicas (``_fleet_scaling``)."""
     rows = int(os.environ.get("BENCH_PREDICT_ROWS", 1_000_000))
     train_rows = int(os.environ.get("BENCH_PREDICT_TRAIN_ROWS", 100_000))
     trees = int(os.environ.get("BENCH_PREDICT_TREES", 40))
@@ -118,8 +190,10 @@ def predict_main() -> None:
             "p99_ms": round(float(np.percentile(lat, 99)), 3),
         }
     top = batches[str(max(sizes))]
+    fleet = _fleet_scaling(booster, X32, concurrency) if concurrency \
+        else None
     from lightgbm_tpu.obs import compile_ledger
-    print(json.dumps({
+    result = {
         "metric": f"serve_rows_per_sec_higgslike_{trees}trees_"
                   "63leaves_255bins_binary",
         "value": top["rows_per_sec"],
@@ -128,12 +202,22 @@ def predict_main() -> None:
         "batches": batches,
         "warmup_s": round(t_warm, 3),
         "compile_events": compile_ledger.summary(5),
-    }))
+    }
+    if fleet is not None:
+        result["concurrency"] = concurrency
+        result["fleet"] = fleet
+    print(json.dumps(result))
     c = obs.snapshot()["counters"]
+    tail = ""
+    if fleet is not None:
+        tail = (" fleet_rows_per_sec=" + ",".join(
+            f"{r}:{fleet[r]['rows_per_sec']:g}" for r in sorted(
+                fleet, key=int)))
     print(f"# device={jax.devices()[0].platform} train_s={t_train:.1f} "
           f"warmup_s={t_warm:.1f} calls_per_size={calls} "
           f"serve_compiles={c.get('serve_forest_compiles', 0)} "
-          f"post_warmup_compiles_expected=0", file=sys.stderr)
+          f"post_warmup_compiles_expected=0"
+          f"{tail}", file=sys.stderr)
 
 
 def main() -> None:
@@ -248,18 +332,26 @@ def main() -> None:
           file=sys.stderr)
 
 
-def _parse_mode(argv) -> str:
-    mode = "train"
+def _parse_opt(argv, name: str, default: str) -> str:
+    """``--name value`` / ``--name=value`` — no argparse so the BENCH
+    invocation stays copy-pasteable into constrained drivers."""
+    val = default
     for i, tok in enumerate(argv):
-        if tok == "--mode" and i + 1 < len(argv):
-            mode = argv[i + 1]
-        elif tok.startswith("--mode="):
-            mode = tok.split("=", 1)[1]
-    return mode
+        if tok == f"--{name}" and i + 1 < len(argv):
+            val = argv[i + 1]
+        elif tok.startswith(f"--{name}="):
+            val = tok.split("=", 1)[1]
+    return val
+
+
+def _parse_mode(argv) -> str:
+    return _parse_opt(argv, "mode", "train")
 
 
 if __name__ == "__main__":
     if _parse_mode(sys.argv[1:]) == "predict":
-        predict_main()
+        predict_main(concurrency=int(_parse_opt(
+            sys.argv[1:], "concurrency",
+            os.environ.get("BENCH_PREDICT_CONCURRENCY", "0"))))
     else:
         main()

@@ -35,6 +35,21 @@ DESC = {
     "predict_buckets": "batch bucket ladder for the compiled-forest "
                        "predict paths (comma-separated sizes; empty = "
                        "powers of two 16..65536; docs/SERVING.md)",
+    "serve_replicas": "task=serve: device replicas in the fleet — one "
+                      "CompiledForest + micro-batcher per local device "
+                      "(0 = all of jax.local_devices(); serve/fleet.py, "
+                      "docs/SERVING.md §Fleet)",
+    "serve_queue_depth": "task=serve: pending-request cap per replica "
+                         "queue; beyond it requests shed with 429 + "
+                         "Retry-After (0 = unbounded)",
+    "serve_max_inflight": "task=serve: fleet-wide cap on admitted "
+                          "requests in flight; beyond it requests shed "
+                          "with 429 + Retry-After (0 = unbounded)",
+    "serve_canary_model": "task=serve: optional second model file served "
+                          "at serve_canary_weight traffic share (A/B "
+                          "routing; metrics labeled model=canary)",
+    "serve_canary_weight": "task=serve: canary traffic share in [0, 1) — "
+                           "deterministic rotation, exact split",
     "events_file": "per-iteration JSONL telemetry stream path "
                    "(docs/OBSERVABILITY.md; --events-file on the CLI)",
     "trace_dir": "device trace output dir; LIGHTGBM_TPU_TRACE_DIR env "

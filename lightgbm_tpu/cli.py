@@ -139,9 +139,12 @@ def run_predict(config: Config, params: Dict[str, str]) -> None:
 
 
 def run_serve(config: Config, params: Dict[str, str]) -> None:
-    """task=serve: freeze ``input_model`` into a CompiledForest, warm
-    every bucket, and serve micro-batched predictions over HTTP until
-    SIGINT/SIGTERM (lightgbm_tpu/serve/, docs/SERVING.md)."""
+    """task=serve: freeze ``input_model`` into one CompiledForest per
+    local device (``serve_replicas`` caps the fleet), warm every bucket
+    on every replica, and serve micro-batched predictions over HTTP —
+    with least-loaded dispatch, admission control and ``POST /reload``
+    hot swaps — until SIGINT/SIGTERM (lightgbm_tpu/serve/,
+    docs/SERVING.md)."""
     from .serve.server import serve_from_config
     server = serve_from_config(config, params)
     server.serve_forever()
@@ -158,7 +161,10 @@ def main(argv=None) -> int:
               "[snapshot_dir=<dir> snapshot_freq=<K>] "
               "[nan_policy=fail_fast|skip_tree]\n"
               "       python -m lightgbm_tpu serve input_model=<model> "
-              "[serve_port=<p> serve_max_batch=<n> serve_max_delay_ms=<ms>]\n"
+              "[serve_port=<p> serve_max_batch=<n> serve_max_delay_ms=<ms> "
+              "serve_replicas=<k> serve_queue_depth=<n> "
+              "serve_max_inflight=<n> "
+              "serve_canary_model=<model> serve_canary_weight=<w>]\n"
               "       python -m lightgbm_tpu obs-report <events.jsonl ...> "
               "[--format=json|table] [--top=K] [--compile=<ledger.jsonl>]\n"
               "       python -m lightgbm_tpu obs-report --traces "

@@ -208,6 +208,12 @@ _DEFAULTS: Dict[str, Any] = {
     "serve_max_batch": 8192,    # micro-batcher row cap per device batch
     "serve_max_delay_ms": 5.0,  # micro-batch coalescing deadline
     "predict_buckets": [],      # batch bucket ladder ([] = powers of two)
+    # serving fleet (serve/fleet.py: replicas, admission, canary)
+    "serve_replicas": 0,        # device replicas (0 = all local devices)
+    "serve_queue_depth": 128,   # pending requests per replica (0 = no cap)
+    "serve_max_inflight": 0,    # fleet-wide in-flight cap (0 = no cap)
+    "serve_canary_model": "",   # optional second model file (A/B routing)
+    "serve_canary_weight": 0.0,  # canary traffic share in [0, 1)
     # observability (lightgbm_tpu/obs/; docs/OBSERVABILITY.md)
     "events_file": "",         # per-iteration JSONL event stream path
     "trace_dir": "",           # device trace dir (LIGHTGBM_TPU_TRACE_DIR wins)
@@ -374,6 +380,19 @@ class Config:
             raise ValueError("serve_max_delay_ms must be >= 0")
         if any(b <= 0 for b in v["predict_buckets"]):
             raise ValueError("predict_buckets must be positive sizes")
+        if v["serve_replicas"] < 0:
+            raise ValueError("serve_replicas must be >= 0 "
+                             "(0 = one replica per local device)")
+        if v["serve_queue_depth"] < 0:
+            raise ValueError("serve_queue_depth must be >= 0 (0 = no cap)")
+        if v["serve_max_inflight"] < 0:
+            raise ValueError("serve_max_inflight must be >= 0 (0 = no cap)")
+        if not (0.0 <= v["serve_canary_weight"] < 1.0):
+            raise ValueError("serve_canary_weight must be in [0, 1) — the "
+                             "canary is a minority share, not the primary")
+        if v["serve_canary_weight"] > 0 and not v["serve_canary_model"]:
+            raise ValueError("serve_canary_weight > 0 needs a "
+                             "serve_canary_model file to route to")
         # num_machines here means mesh devices; 1 device => normalize back to
         # serial like the reference (config.cpp:161-172).
         if v["num_machines"] <= 1:

@@ -58,6 +58,7 @@ from .compile_ledger import (InstrumentedJit, abstract_shapes,  # noqa: F401
 from .events import SCHEMA_VERSION, EventRecorder, read_events  # noqa: F401
 from .phases import (DEVICE_PARENT, DEVICE_PHASES,  # noqa: F401
                      HOST_PHASES, JITTED_HOST_PHASES, span_series)
+from .prom import labeled_name, split_series  # noqa: F401
 from .registry import (DEFAULT_BYTE_BUCKETS,  # noqa: F401
                        DEFAULT_TIME_BUCKETS, REGISTRY, Registry,
                        get_counter, get_gauge, get_histogram,
@@ -96,7 +97,7 @@ __all__ = [
     "get_gauge", "get_histogram", "histogram_quantile",
     "DEFAULT_TIME_BUCKETS", "DEFAULT_BYTE_BUCKETS",
     "snapshot", "merge", "reset", "restore",
-    "span", "timed", "span_series",
+    "span", "timed", "span_series", "labeled_name", "split_series",
     "EventRecorder", "read_events", "SCHEMA_VERSION",
     "TraceCapture",
     "instrumented_jit", "InstrumentedJit", "abstract_shapes",

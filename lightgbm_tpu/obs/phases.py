@@ -35,6 +35,10 @@ HOST_PHASES = frozenset({
     "Serve::queue",       # enqueue -> coalesced-batch pickup wait
     "Serve::batch",       # micro-batch assembly + device dispatch
     "Predict::forest",    # one CompiledForest bucket call
+    # serving fleet (serve/fleet.py: replicas, hot reload, admission)
+    "Serve::dispatch",    # routing decision: canary split + least-loaded
+    "Serve::reload",      # hot swap: build + warm a new generation
+    "Serve::drain",       # old generation: wait out in-flight, close
 })
 
 DEVICE_PHASES = frozenset({

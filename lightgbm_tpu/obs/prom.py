@@ -12,7 +12,18 @@ never blocks a writer for more than the snapshot copy.
 the in-repo tests (and ``tools/bench_regress.py``-style offline checks)
 to validate an exposition without a prometheus client: it returns every
 sample with its labels plus the declared types, and
-``histogram_series()`` reassembles one histogram's cumulative buckets.
+``histogram_series()`` reassembles one histogram's cumulative buckets
+(``match=`` filters one label set out of a multi-label family).
+
+Dimensioned series: the registry itself is flat-keyed, so labels ride
+INSIDE the key using the Prometheus sample syntax —
+``labeled_name("serve_requests", model="canary")`` yields the canonical
+key ``serve_requests{model="canary"}`` (labels sorted, values escaped),
+and ``split_series`` parses it back.  ``render()`` groups keys sharing a
+base name into ONE family (one ``# TYPE`` line) with the embedded labels
+attached per sample, which is how the serve fleet's ``model=`` dimension
+(docs/SERVING.md) reaches scrapers without the registry growing a label
+store.
 
 TYPE-line policy: every family gets a ``# TYPE`` line; unknown gauge
 values that are not numeric are skipped (the registry allows arbitrary
@@ -46,6 +57,49 @@ def metric_name(name: str) -> str:
 def _escape_label(value: str) -> str:
     return (str(value).replace("\\", r"\\").replace("\n", r"\n")
             .replace('"', r'\"'))
+
+
+def _unescape_label(value: str) -> str:
+    # single-pass unescape: chained str.replace would corrupt a literal
+    # backslash followed by 'n' or '"'
+    return re.sub(r"\\(.)",
+                  lambda e: {"n": "\n"}.get(e.group(1), e.group(1)), value)
+
+
+def labeled_name(name: str, labels: Optional[Mapping[str, str]] = None,
+                 **kw: str) -> str:
+    """Canonical flat registry key for a labeled series:
+    ``labeled_name("serve_requests", model="canary")`` ->
+    ``serve_requests{model="canary"}``.  Labels are sorted and values
+    escaped, so the same (name, labels) always maps to the same key —
+    writers and readers agree without a registry-side label store."""
+    merged: Dict[str, str] = dict(labels or {})
+    merged.update(kw)
+    if not merged:
+        return name
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(merged.items()))
+    return f"{name}{{{inner}}}"
+
+
+def split_series(key: str) -> Tuple[str, Dict[str, str]]:
+    """Parse a registry key back into ``(base_name, labels)``.  Keys
+    without a well-formed ``{k="v",...}`` suffix come back verbatim with
+    no labels (the whole key then goes through ``metric_name``'s
+    sanitizer, so a malformed key degrades to an ugly name, never a
+    crash)."""
+    if not key.endswith("}"):
+        return key, {}
+    brace = key.find("{")
+    if brace <= 0:
+        return key, {}
+    body = key[brace + 1:-1]
+    leftover = _LABEL_RE.sub("", body)
+    if re.sub(r"[,\s]", "", leftover):
+        return key, {}
+    labels = {m.group(1): _unescape_label(m.group(2))
+              for m in _LABEL_RE.finditer(body)}
+    return key[:brace], labels
 
 
 def _labels_str(labels: Optional[Mapping[str, str]],
@@ -86,20 +140,33 @@ def render(snap: Optional[Mapping[str, Any]] = None,
         snap = registry.snapshot()
     lines: List[str] = []
 
-    for name in sorted(snap.get("counters", {})):
-        m = metric_name(name)
-        lines.append(f"# TYPE {m} counter")
-        lines.append(
-            f"{m}{_labels_str(labels)} "
-            f"{_fmt(snap['counters'][name])}")
+    def _families(keys):
+        """Group flat registry keys by base name: labeled variants of
+        one series render as ONE family (single # TYPE line), each
+        sample carrying its embedded labels."""
+        fams: Dict[str, List[Tuple[str, Dict[str, str]]]] = {}
+        for key in keys:
+            base, embedded = split_series(key)
+            fams.setdefault(base, []).append((key, embedded))
+        for base in sorted(fams):
+            # unlabeled sample first, then labeled ones in key order
+            yield base, sorted(fams[base], key=lambda e: e[0])
 
-    for name in sorted(snap.get("gauges", {})):
-        v = snap["gauges"][name]
-        if isinstance(v, bool) or not isinstance(v, (int, float)):
-            continue                    # non-numeric gauge payloads
-        m = metric_name(name)
+    for base, entries in _families(snap.get("counters", {})):
+        m = metric_name(base)
+        lines.append(f"# TYPE {m} counter")
+        for key, embedded in entries:
+            lines.append(f"{m}{_labels_str(labels, embedded)} "
+                         f"{_fmt(snap['counters'][key])}")
+
+    gauges = {k: v for k, v in snap.get("gauges", {}).items()
+              if not isinstance(v, bool) and isinstance(v, (int, float))}
+    for base, entries in _families(gauges):
+        m = metric_name(base)
         lines.append(f"# TYPE {m} gauge")
-        lines.append(f"{m}{_labels_str(labels)} {_fmt(v)}")
+        for key, embedded in entries:
+            lines.append(f"{m}{_labels_str(labels, embedded)} "
+                         f"{_fmt(gauges[key])}")
 
     # TIMETAG accumulators (empty unless the serializing mode is on):
     # one family, phase as a label — the reference taxonomy names
@@ -113,22 +180,27 @@ def render(snap: Optional[Mapping[str, Any]] = None,
                 f"{m}{_labels_str(labels, {'phase': name})} "
                 f"{_fmt(phase[name])}")
 
-    for name in sorted(snap.get("histograms", {})):
-        h = snap["histograms"][name]
-        m = metric_name(name)
+    for base, entries in _families(snap.get("histograms", {})):
+        m = metric_name(base)
         lines.append(f"# TYPE {m} histogram")
-        cum = 0
-        for bound, c in zip(h["buckets"], h["counts"]):
-            cum += int(c)
+        for key, embedded in entries:
+            h = snap["histograms"][key]
+            cum = 0
+            for bound, c in zip(h["buckets"], h["counts"]):
+                cum += int(c)
+                lines.append(
+                    f"{m}_bucket"
+                    f"{_labels_str(labels, {**embedded, 'le': _fmt(bound)})}"
+                    f" {cum}")
+            cum += int(h["counts"][len(h["buckets"])])
             lines.append(
-                f"{m}_bucket{_labels_str(labels, {'le': _fmt(bound)})} "
-                f"{cum}")
-        cum += int(h["counts"][len(h["buckets"])])
-        lines.append(
-            f"{m}_bucket{_labels_str(labels, {'le': '+Inf'})} {cum}")
-        lines.append(f"{m}_sum{_labels_str(labels)} {_fmt(h['sum'])}")
-        lines.append(
-            f"{m}_count{_labels_str(labels)} {_fmt(h['count'])}")
+                f"{m}_bucket"
+                f"{_labels_str(labels, {**embedded, 'le': '+Inf'})} {cum}")
+            lines.append(
+                f"{m}_sum{_labels_str(labels, embedded)} {_fmt(h['sum'])}")
+            lines.append(
+                f"{m}_count{_labels_str(labels, embedded)} "
+                f"{_fmt(h['count'])}")
 
     return "\n".join(lines) + "\n"
 
@@ -181,12 +253,7 @@ def parse_text(text: str) -> Dict[str, Any]:
                 raise ValueError(
                     f"line {lineno}: malformed labels: {rawlabels!r}")
             for lm in _LABEL_RE.finditer(rawlabels):
-                # single-pass unescape: chained str.replace would corrupt
-                # a literal backslash followed by 'n' or '"'
-                labels[lm.group(1)] = re.sub(
-                    r"\\(.)",
-                    lambda e: {"n": "\n"}.get(e.group(1), e.group(1)),
-                    lm.group(2))
+                labels[lm.group(1)] = _unescape_label(lm.group(2))
         samples.append((name, labels, _parse_value(value)))
     return {"types": types, "samples": samples}
 
@@ -194,19 +261,38 @@ def parse_text(text: str) -> Dict[str, Any]:
 def histogram_series(parsed: Mapping[str, Any], family: str,
                      match: Optional[Mapping[str, str]] = None) \
         -> Dict[str, Any]:
-    """Reassemble one histogram family from parsed samples:
+    """Reassemble ONE histogram of a family from parsed samples:
     ``{"buckets": [(le, cumulative), ...], "sum": x, "count": n}``.
-    ``match`` filters on non-``le`` labels (e.g. a rank)."""
-    buckets: List[Tuple[float, float]] = []
-    out: Dict[str, Any] = {"buckets": buckets, "sum": None, "count": None}
+    ``match`` filters on non-``le`` labels (e.g. a rank, or
+    ``{"model": "canary"}``).
+
+    A family may carry several label sets (the fleet renders the
+    unlabeled aggregate and its ``model=`` variants as one family);
+    mixing them would interleave duplicate ``le`` buckets and corrupt
+    any quantile read.  When more than one label set survives the
+    ``match`` filter, the one with the FEWEST labels wins — i.e. the
+    unlabeled aggregate (plus scrape-time labels like ``rank``), which
+    is exactly what a matchless call meant before labels existed."""
+    groups: Dict[Tuple, Dict[str, Any]] = {}
     for name, labels, value in parsed["samples"]:
         if match and any(labels.get(k) != v for k, v in match.items()):
             continue
+        if name not in (family + "_bucket", family + "_sum",
+                        family + "_count"):
+            continue
+        key = tuple(sorted((k, v) for k, v in labels.items()
+                           if k != "le"))
+        g = groups.setdefault(key, {"buckets": [], "sum": None,
+                                    "count": None})
         if name == family + "_bucket" and "le" in labels:
-            buckets.append((_parse_value(labels["le"]), value))
+            g["buckets"].append((_parse_value(labels["le"]), value))
         elif name == family + "_sum":
-            out["sum"] = value
-        elif name == family + "_count":
-            out["count"] = value
-    buckets.sort(key=lambda t: t[0])
+            g["sum"] = value
+        else:
+            g["count"] = value
+    if not groups:
+        return {"buckets": [], "sum": None, "count": None}
+    key = min(groups, key=lambda k: (len(k), k))
+    out = groups[key]
+    out["buckets"].sort(key=lambda t: t[0])
     return out

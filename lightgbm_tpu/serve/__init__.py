@@ -2,17 +2,28 @@
 
 ``forest``  — freeze a trained/loaded booster into an immutable
               :class:`CompiledForest`: SoA tree stacks, forest-derived
-              cut tables, one fused bin-lookup -> walk -> transform jit.
+              cut tables, one fused bin-lookup -> walk -> transform jit
+              (``to_device`` pins per-replica copies).
 ``batcher`` — shape-bucketed compile cache (:class:`BucketLadder`,
               ``warmup()`` pre-compiles every bucket) + the
               :class:`MicroBatcher` that coalesces concurrent requests
-              into device batches under a latency deadline.
+              into device batches under a latency deadline (bounded
+              queue + per-model metric labels for the fleet).
+``fleet``   — :class:`Fleet` of per-device replicas: least-loaded
+              dispatch, admission control (shed with retry-after),
+              canary routing, and :class:`ModelManager` zero-downtime
+              hot reload.
 ``server``  — stdlib HTTP front end (``python -m lightgbm_tpu serve``).
 """
 
-from .batcher import BucketLadder, MicroBatcher, default_ladder  # noqa: F401
+from .batcher import (BucketLadder, MicroBatcher, QueueFull,  # noqa: F401
+                      default_ladder)
+from .fleet import (Fleet, FleetResult, ModelManager,  # noqa: F401
+                    Overloaded, Replica, ReplicaSet, fleet_devices)
 from .forest import CompiledForest  # noqa: F401
 from .server import PredictServer, serve_from_config  # noqa: F401
 
-__all__ = ["CompiledForest", "BucketLadder", "MicroBatcher",
-           "default_ladder", "PredictServer", "serve_from_config"]
+__all__ = ["CompiledForest", "BucketLadder", "MicroBatcher", "QueueFull",
+           "default_ladder", "Fleet", "FleetResult", "ModelManager",
+           "Overloaded", "Replica", "ReplicaSet", "fleet_devices",
+           "PredictServer", "serve_from_config"]

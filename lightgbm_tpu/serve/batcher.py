@@ -32,11 +32,18 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .. import obs
+
+
+class QueueFull(RuntimeError):
+    """``submit()`` refused: the batcher's bounded queue sits at
+    ``max_queue`` pending requests.  The fleet dispatcher converts this
+    into a 429 shed (serve/fleet.py) — an unbounded queue would convert
+    overload into unbounded p99 instead."""
 
 
 def default_ladder(lo: int = 16, hi: int = 65536) -> List[int]:
@@ -152,14 +159,30 @@ class MicroBatcher:
     ``GET /metrics``), and the historical
     ``serve_latency_p50_ms``/``serve_latency_p99_ms`` gauges kept as
     values DERIVED from that histogram (bucket interpolation — estimates
-    now, not exact order statistics over a ring).
+    now, not exact order statistics over a ring).  With
+    ``metric_labels`` (the fleet passes ``{"model": ...}``) every
+    counter and the latency histogram ALSO land in a labeled series
+    (``obs.labeled_name``), so per-model traffic is scrapeable next to
+    the fleet-wide aggregate.
+
+    ``max_queue`` bounds the PENDING queue (0 = unbounded, the
+    historical behavior): a submit against a full queue raises
+    :class:`QueueFull` instead of parking — admission control for the
+    fleet dispatcher.
     """
 
     def __init__(self, predict_fn: Callable[[np.ndarray], np.ndarray],
-                 max_batch: int = 8192, max_delay_s: float = 0.005):
+                 max_batch: int = 8192, max_delay_s: float = 0.005,
+                 max_queue: int = 0,
+                 metric_labels: Optional[Mapping[str, str]] = None):
         self.predict_fn = predict_fn
         self.max_batch = max(int(max_batch), 1)
         self.max_delay_s = max(float(max_delay_s), 0.0)
+        self.max_queue = max(int(max_queue), 0)
+        self._labels = dict(metric_labels or {})
+        # labels are fixed for the batcher's lifetime: memoize the
+        # name -> labeled-key string math off the per-request path
+        self._labeled_keys: dict = {}
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: List[_Pending] = []
@@ -170,19 +193,48 @@ class MicroBatcher:
                                         daemon=True)
         self._worker.start()
 
+    def _labeled(self, name: str) -> str:
+        key = self._labeled_keys.get(name)
+        if key is None:
+            key = self._labeled_keys[name] = obs.labeled_name(
+                name, self._labels)
+        return key
+
+    def _inc(self, name: str, n: int = 1) -> None:
+        """Counter write, mirrored into the labeled series when this
+        batcher carries metric labels (one base account + one
+        ``{model=...}`` dimension; obs/prom.py renders both as one
+        family)."""
+        obs.inc(name, n)
+        if self._labels:
+            obs.inc(self._labeled(name), n)
+
+    def queue_depth(self) -> int:
+        """Pending (not yet picked up) requests — the fleet dispatcher's
+        load signal and the ``/stats`` per-replica depth."""
+        with self._cond:
+            return len(self._queue)
+
     # -- client side -----------------------------------------------------
     def submit(self, rows: np.ndarray, timeout: Optional[float] = None):
         """Block until the batch containing ``rows`` is served; returns
-        whatever ``predict_fn`` produced for this request's row span."""
+        whatever ``predict_fn`` produced for this request's row span.
+        Raises :class:`QueueFull` (shedding, no wait) when a bounded
+        queue is at capacity."""
         rows = np.ascontiguousarray(rows)
         req = _Pending(rows)
         with self._cond:
             if self._closed:
+                obs.trace_end(req.tspan, args={"closed": True})
                 raise RuntimeError("MicroBatcher is closed")
+            if self.max_queue and len(self._queue) >= self.max_queue:
+                obs.trace_end(req.tspan, args={"shed": True})
+                raise QueueFull(
+                    f"queue at max_queue={self.max_queue} pending requests")
             self._queue.append(req)
             self._cond.notify_all()
-        obs.inc("serve_requests")
-        obs.inc("serve_rows", int(rows.shape[0]))
+        self._inc("serve_requests")
+        self._inc("serve_rows", int(rows.shape[0]))
         if not req.done.wait(timeout):
             # shed the request: a timed-out entry left in the queue
             # would still be computed AND hold max_batch capacity ahead
@@ -196,7 +248,7 @@ class MicroBatcher:
                 # never end its queue span; a picked-up-but-slow request
                 # had its span closed at batch start
                 obs.trace_end(req.tspan, args={"shed": True})
-            obs.inc("serve_timeouts_shed")
+            self._inc("serve_timeouts_shed")
             raise TimeoutError("predict request timed out")
         if req.error is not None:
             raise req.error
@@ -265,8 +317,8 @@ class MicroBatcher:
                     with obs.trace_span("Predict::forest",
                                         args={"rows": int(rows.shape[0])}):
                         out = self.predict_fn(rows)
-                obs.inc("serve_batches")
-                obs.inc("serve_batch_rows", int(rows.shape[0]))
+                self._inc("serve_batches")
+                self._inc("serve_batch_rows", int(rows.shape[0]))
                 obs.set_gauge("serve_last_batch_rows", int(rows.shape[0]))
                 off = 0
                 for req in batch:
@@ -289,6 +341,8 @@ class MicroBatcher:
         # request and every _GAUGE_EVERY after — the quantile walk is
         # too much bookkeeping to pay per request under load.
         obs.observe("serve_latency_seconds", ms / 1000.0)
+        if self._labels:
+            obs.observe(self._labeled("serve_latency_seconds"), ms / 1000.0)
         with self._lock:
             self._lat_seq += 1
             if self._lat_seq % self._GAUGE_EVERY != 1 \

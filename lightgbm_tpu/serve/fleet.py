@@ -1,0 +1,536 @@
+"""Serving fleet: per-device replicas, admission control, hot reload.
+
+One ``CompiledForest`` behind one ``MicroBatcher`` saturates one device
+and dies with its process.  Serving heavy traffic needs the layer above
+(ROADMAP item 5), and this module is it:
+
+- :class:`Replica` / :class:`ReplicaSet` — one frozen+warmed forest per
+  local device (``jax.local_devices()``, capped by ``serve_replicas``),
+  each with its own micro-batcher, each explicitly ``device_put`` onto
+  its device (``CompiledForest.to_device``) so no request ever pays a
+  cross-device transfer.  The per-replica batching-for-occupancy logic
+  is the same trade "XGBoost: Scalable GPU Accelerated Learning"
+  (arXiv:1806.11248) makes for prediction: the accelerator wants few
+  large launches, the clients want low latency, the deadline-coalesced
+  batch is the meeting point — the fleet just multiplies it by K
+  devices.
+- **least-loaded dispatch** — :meth:`Fleet.submit` routes each request
+  to the replica with the lowest load score: outstanding requests
+  (queued + in-flight) weighted by an EWMA of the replica's observed
+  service time, so a replica that is slow (thermals, a straggler batch)
+  organically receives less traffic than its peers.
+- **admission control** — per-replica queues are bounded
+  (``serve_queue_depth`` -> ``MicroBatcher(max_queue=...)``) and the
+  fleet caps total in-flight requests (``serve_max_inflight``).  Beyond
+  either limit a request is SHED: :class:`Overloaded` carries a
+  retry-after hint derived from the observed p50 service time, the HTTP
+  layer turns it into ``429`` + ``Retry-After``, and ``serve_shed_total``
+  (per ``model=`` label) counts it.  Overload then bends p99 of the
+  admitted requests instead of growing the queue without bound.
+- **zero-downtime hot reload** — :class:`ModelManager.reload` builds and
+  ``warmup()``s a whole new generation OFF the serving path (the old
+  generation keeps serving throughout), atomically swaps it in, then
+  drains the old one: in-flight requests finish on the forest they
+  started on, and only then are the old batchers closed.  Every response
+  echoes the generation id that served it, and the compile ledger stays
+  flat after the swap because the new generation warmed on its own
+  devices.
+- **canary / A-B routing** — an optional second :class:`ReplicaSet`
+  takes ``serve_canary_weight`` of traffic via a deterministic
+  weight-accumulator rotation (exact split, no RNG).  Every serve
+  metric the batcher writes carries a ``model=`` label
+  (``obs.labeled_name``), so the canary's latency histogram and shed
+  counters are scrapeable side by side with the primary's.
+
+Spans: ``Serve::dispatch`` (the routing decision, with
+model/generation/replica recorded into the request's causal trace),
+``Serve::reload`` (build + warm + swap) and ``Serve::drain`` (waiting
+out the old generation) — all in the ``obs/phases.py`` taxonomy and
+lint-enforced like every other span site.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import obs
+from ..utils import log
+from ..utils.log import LightGBMError
+from .batcher import MicroBatcher, QueueFull
+
+# EWMA smoothing for per-replica service time: ~the last 10 requests
+# dominate, old incidents decay instead of haunting the dispatch forever
+_EWMA_ALPHA = 0.2
+
+# a replica that has never served anything scores with this service time
+# (seconds) so the comparison stays outstanding-count-driven until real
+# measurements exist
+_EWMA_FLOOR = 1e-4
+
+
+class Overloaded(RuntimeError):
+    """Admission control shed this request.  ``retry_after_s`` is the
+    backoff hint (from the observed p50 service time) the HTTP layer
+    renders as the ``Retry-After`` header."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class FleetResult:
+    """One served request: the prediction pair plus WHERE it ran —
+    model / generation / replica are echoed in the HTTP response so a
+    client (and the hot-reload test) can pin predictions to the forest
+    that produced them."""
+
+    __slots__ = ("raw", "out", "model", "generation", "replica")
+
+    def __init__(self, raw, out, model: str, generation: int, replica: int):
+        self.raw = raw
+        self.out = out
+        self.model = model
+        self.generation = generation
+        self.replica = replica
+
+
+class Replica:
+    """One forest pinned to one device, behind its own micro-batcher.
+
+    ``inflight`` (dispatched, not yet answered — queued requests
+    included) and ``ewma_service_s`` are the dispatcher's load signal;
+    both are guarded by the owning Fleet's lock, not a lock of their
+    own, so the pick-and-increment is one atomic step."""
+
+    def __init__(self, forest, replica_id: int, model: str,
+                 generation: int, *, max_batch: int, max_delay_s: float,
+                 max_queue: int):
+        self.forest = forest
+        self.replica_id = int(replica_id)
+        self.model = str(model)
+        self.generation = int(generation)
+        self.device = getattr(forest, "device", None)
+        self.batcher = MicroBatcher(forest.batched_fn(),
+                                    max_batch=max_batch,
+                                    max_delay_s=max_delay_s,
+                                    max_queue=max_queue,
+                                    metric_labels={"model": self.model})
+        self.inflight = 0
+        self.requests = 0
+        self.ewma_service_s = 0.0
+
+    def note_done(self, seconds: float) -> None:
+        """Fold one completed request's service time into the EWMA
+        (called under the fleet lock)."""
+        self.requests += 1
+        if self.ewma_service_s <= 0.0:
+            self.ewma_service_s = float(seconds)
+        else:
+            self.ewma_service_s += _EWMA_ALPHA * (float(seconds)
+                                                  - self.ewma_service_s)
+
+    def load_score(self) -> float:
+        """Expected wait behind this replica: outstanding requests
+        (its own + one) times its smoothed service time.  A slow replica
+        with the same backlog scores worse than a fast one."""
+        return (self.inflight + 1) * max(self.ewma_service_s, _EWMA_FLOOR)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "replica": self.replica_id,
+            "model": self.model,
+            "generation": self.generation,
+            "device": str(self.device) if self.device is not None else None,
+            "queue_depth": self.batcher.queue_depth(),
+            "inflight": self.inflight,
+            "requests": self.requests,
+            "ewma_service_ms": round(self.ewma_service_s * 1000.0, 3),
+        }
+
+
+class ReplicaSet:
+    """One model generation spread over the fleet's devices.
+
+    ``outstanding`` counts dispatches currently holding a reference to
+    this set (fleet-lock guarded); the drain after a hot swap waits for
+    it to reach zero before closing the batchers, which is what makes
+    "in-flight requests finish on the forest they started on" true
+    rather than aspirational."""
+
+    def __init__(self, replicas: Sequence[Replica], model: str,
+                 generation: int, model_path: str = ""):
+        if not replicas:
+            raise ValueError("a ReplicaSet needs at least one replica")
+        self.replicas = list(replicas)
+        self.model = str(model)
+        self.generation = int(generation)
+        self.model_path = str(model_path)
+        self.outstanding = 0
+
+    @classmethod
+    def build(cls, forest, devices: Sequence, model: str, generation: int,
+              *, max_batch: int, max_delay_s: float, max_queue: int,
+              warm: bool = True, model_path: str = "") -> "ReplicaSet":
+        """Freeze one forest into a replica per device.  A ``None``
+        device reuses ``forest`` as-is (default placement — the
+        single-replica compatibility path keeps the caller's warmed
+        jits); a real device gets an explicit ``to_device`` copy, warmed
+        THERE so its compiles are done before the set takes traffic."""
+        replicas = []
+        for i, dev in enumerate(devices):
+            f = forest if dev is None else forest.to_device(dev)
+            if warm:
+                f.warmup(max_bucket=max_batch)
+            replicas.append(Replica(f, i, model, generation,
+                                    max_batch=max_batch,
+                                    max_delay_s=max_delay_s,
+                                    max_queue=max_queue))
+        return cls(replicas, model, generation, model_path=model_path)
+
+    @property
+    def num_features(self) -> int:
+        return int(self.replicas[0].forest.num_features)
+
+    def close(self, drain: bool = True) -> None:
+        for rep in self.replicas:
+            rep.batcher.close(drain=drain)
+
+
+def fleet_devices(replicas: int = 0) -> List:
+    """The devices the fleet spreads over: ``jax.local_devices()``,
+    capped by ``serve_replicas`` when positive (0 = one replica per
+    local device)."""
+    import jax
+
+    devs = list(jax.local_devices())
+    n = int(replicas)
+    if n > 0:
+        devs = devs[:n]
+    return devs
+
+
+class Fleet:
+    """Replica dispatcher + admission controller + generation holder.
+
+    Thread-safe: ``submit()`` is called from every HTTP handler thread;
+    the routing decision, the in-flight accounting and generation swaps
+    all happen under one condition variable (``_cond``), while the
+    actual prediction wait happens inside the chosen replica's batcher
+    with no fleet lock held."""
+
+    def __init__(self, primary: ReplicaSet,
+                 canary: Optional[ReplicaSet] = None,
+                 canary_weight: float = 0.0, max_inflight: int = 0,
+                 devices: Optional[Sequence] = None,
+                 max_batch: int = 8192, max_delay_s: float = 0.005,
+                 max_queue: int = 0):
+        self._cond = threading.Condition()
+        self._primary = primary
+        self._canary = canary
+        self.canary_weight = float(canary_weight)
+        if not (0.0 <= self.canary_weight < 1.0):
+            raise ValueError("canary_weight must be in [0, 1)")
+        if canary is not None and canary.num_features != primary.num_features:
+            raise LightGBMError(
+                f"canary model takes {canary.num_features} features, the "
+                f"primary takes {primary.num_features} — A/B routing needs "
+                f"one request schema")
+        self.max_inflight = max(int(max_inflight), 0)
+        # generation-build knobs, reused by every later promote()
+        self.devices = (list(devices) if devices is not None
+                        else [r.device for r in primary.replicas])
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_s)
+        self.max_queue = int(max_queue)
+        self._inflight = 0
+        self._canary_acc = 0.0
+        self._gen_seq = max(primary.generation,
+                            canary.generation if canary else 0)
+        self._closed = False
+        obs.set_gauge("serve_generation", primary.generation)
+        obs.set_gauge("serve_replicas", len(primary.replicas))
+
+    # -- construction ----------------------------------------------------
+    @classmethod
+    def build(cls, forest, devices: Optional[Sequence] = None,
+              replicas: int = 0, model: str = "primary",
+              canary_forest=None, canary_weight: float = 0.0,
+              max_batch: int = 8192, max_delay_s: float = 0.005,
+              max_queue: int = 0, max_inflight: int = 0,
+              warm: bool = True) -> "Fleet":
+        """Spread ``forest`` over ``devices`` (default: the local
+        devices, capped by ``replicas``) and front it with a dispatcher;
+        ``canary_forest`` adds a second model at ``canary_weight``
+        traffic share on the same devices."""
+        if devices is None:
+            devices = fleet_devices(replicas)
+        primary = ReplicaSet.build(forest, devices, model, 1,
+                                   max_batch=max_batch,
+                                   max_delay_s=max_delay_s,
+                                   max_queue=max_queue, warm=warm)
+        canary = None
+        if canary_forest is not None:
+            canary = ReplicaSet.build(canary_forest, devices, "canary", 2,
+                                      max_batch=max_batch,
+                                      max_delay_s=max_delay_s,
+                                      max_queue=max_queue, warm=warm)
+        return cls(primary, canary, canary_weight=canary_weight,
+                   max_inflight=max_inflight, devices=devices,
+                   max_batch=max_batch, max_delay_s=max_delay_s,
+                   max_queue=max_queue)
+
+    @classmethod
+    def from_forest(cls, forest, max_batch: int = 8192,
+                    max_delay_s: float = 0.005) -> "Fleet":
+        """Single-replica compatibility wrapper: the forest serves
+        as-is on its current device, unbounded queue, no in-flight cap —
+        exactly the pre-fleet ``PredictServer(forest)`` behavior."""
+        return cls.build(forest, devices=[None], max_batch=max_batch,
+                         max_delay_s=max_delay_s, warm=False)
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def primary_forest(self):
+        with self._cond:
+            return self._primary.replicas[0].forest
+
+    @property
+    def num_features(self) -> int:
+        with self._cond:
+            return self._primary.num_features
+
+    @property
+    def generation(self) -> int:
+        with self._cond:
+            return self._primary.generation
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cond:
+            sets = [s for s in (self._primary, self._canary)
+                    if s is not None]
+            return {
+                "generation": self._primary.generation,
+                "inflight": self._inflight,
+                "max_inflight": self.max_inflight,
+                "canary_weight": self.canary_weight,
+                "models": {
+                    s.model: {"generation": s.generation,
+                              "model_path": s.model_path,
+                              "replicas": len(s.replicas)}
+                    for s in sets},
+                "replicas": [rep.stats() for s in sets
+                             for rep in s.replicas],
+            }
+
+    # -- dispatch --------------------------------------------------------
+    def _route(self) -> ReplicaSet:
+        """Primary vs canary: a deterministic weight accumulator — the
+        canary takes exactly its share (every 1/w-th request at weight
+        w), no RNG, so the split test is exact and replayable."""
+        if self._canary is None or self.canary_weight <= 0.0:
+            return self._primary
+        self._canary_acc += self.canary_weight
+        if self._canary_acc >= 1.0:
+            self._canary_acc -= 1.0
+            return self._canary
+        return self._primary
+
+    def _retry_after_s(self) -> float:
+        """Backoff hint for shed requests: one observed p50 service
+        time — by then at least half the in-flight work has drained, so
+        a retry has a real slot to land in."""
+        p50 = obs.histogram_quantile(
+            obs.get_histogram("serve_latency_seconds"), 0.50)
+        return max(float(p50 or 0.0), 0.05)
+
+    def _shed(self, model: str, reason: str) -> Overloaded:
+        obs.inc("serve_shed_total")
+        obs.inc(obs.labeled_name("serve_shed_total", model=model))
+        return Overloaded(reason, self._retry_after_s())
+
+    def submit(self, rows: np.ndarray,
+               timeout: Optional[float] = None) -> FleetResult:
+        """Route one request: canary split, least-loaded replica pick,
+        admission check — then block in that replica's batcher.  Raises
+        :class:`Overloaded` on shed (never queues past the bounds)."""
+        with obs.trace_span("Serve::dispatch") as d:
+            with self._cond:
+                if self._closed:
+                    raise RuntimeError("fleet is closed")
+                rs = self._route()
+                if self.max_inflight and self._inflight >= self.max_inflight:
+                    raise self._shed(
+                        rs.model,
+                        f"fleet at max in-flight ({self.max_inflight})")
+                rep = min(rs.replicas, key=Replica.load_score)
+                rs.outstanding += 1
+                rep.inflight += 1
+                self._inflight += 1
+            if d is not None:
+                d.args.update(model=rs.model, generation=rs.generation,
+                              replica=rep.replica_id)
+        t0 = time.perf_counter()
+        served = False
+        try:
+            raw, out = rep.batcher.submit(rows, timeout=timeout)
+            served = True
+        except QueueFull as exc:
+            raise self._shed(
+                rs.model, f"replica {rep.replica_id}: {exc}") from exc
+        finally:
+            dt = time.perf_counter() - t0
+            with self._cond:
+                rs.outstanding -= 1
+                rep.inflight -= 1
+                self._inflight -= 1
+                if served:
+                    # sheds/timeouts return in ~0s; folding them into
+                    # the EWMA would make an overloaded replica look
+                    # fast and attract MORE traffic
+                    rep.note_done(dt)
+                self._cond.notify_all()
+        return FleetResult(raw, out, rs.model, rs.generation,
+                           rep.replica_id)
+
+    # -- generations -----------------------------------------------------
+    def promote(self, forest, target: str = "primary",
+                model_path: str = "") -> ReplicaSet:
+        """Swap a new generation in for ``target`` (``primary`` or
+        ``canary``).  Build + warmup happen OFF the serving path (the
+        live set keeps taking traffic), the pointer swap is atomic under
+        the fleet lock, and the old set drains before its batchers
+        close — zero requests fail across the swap."""
+        if target not in ("primary", "canary"):
+            raise ValueError(f"unknown reload target {target!r}")
+        current = self._primary if target == "primary" else self._canary
+        if (target == "canary" and current is None
+                and self.canary_weight <= 0.0):
+            raise LightGBMError(
+                "no canary slot: start the server with serve_canary_weight "
+                "> 0 to route traffic to one")
+        with self._cond:
+            # the surviving OTHER set (if any) pins the request schema:
+            # both live models must take the same feature width
+            other = self._canary if target == "primary" else self._primary
+            if other is not None \
+                    and int(forest.num_features) != other.num_features:
+                raise LightGBMError(
+                    f"reloaded {target} takes {forest.num_features} "
+                    f"features, the live {other.model} takes "
+                    f"{other.num_features} — A/B routing needs one "
+                    f"request schema")
+            # provisional id: committed only at swap time, so a build
+            # that fails (warmup OOM, bad device) leaves no gap in the
+            # generation sequence
+            gen = self._gen_seq + 1
+        model = "primary" if target == "primary" else "canary"
+        new_set = ReplicaSet.build(
+            forest, self.devices, model, gen, max_batch=self.max_batch,
+            max_delay_s=self.max_delay_s, max_queue=self.max_queue,
+            warm=True, model_path=model_path)
+        with self._cond:
+            if gen <= self._gen_seq:
+                # a concurrent promote landed first (ModelManager
+                # serializes reloads, but promote() is public API):
+                # renumber before installing — generation is metadata on
+                # the set/replicas, nothing compiled depends on it
+                gen = self._gen_seq + 1
+                new_set.generation = gen
+                for rep in new_set.replicas:
+                    rep.generation = gen
+            self._gen_seq = gen
+            if target == "primary":
+                old, self._primary = self._primary, new_set
+                obs.set_gauge("serve_generation", gen)
+            else:
+                old, self._canary = self._canary, new_set
+        log.info("serve: generation %d (%s) live on %d replica(s); "
+                 "draining generation %s", gen, model,
+                 len(new_set.replicas),
+                 old.generation if old is not None else "-")
+        with obs.span("Serve::drain"):
+            self._drain(old)
+        obs.inc("serve_reloads")
+        return new_set
+
+    def _drain(self, rs: Optional[ReplicaSet],
+               timeout_s: float = 120.0) -> None:
+        """Wait out every dispatch still holding ``rs`` (they finish on
+        the forest they started on), then close its batchers."""
+        if rs is None:
+            return
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while rs.outstanding > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    log.warning(
+                        "serve: drain of generation %d timed out with %d "
+                        "request(s) still in flight", rs.generation,
+                        rs.outstanding)
+                    break
+                self._cond.wait(timeout=min(left, 1.0))
+        rs.close(drain=True)
+        obs.inc("serve_generations_drained")
+
+    def close(self, drain: bool = True) -> None:
+        """Stop dispatching and close every batcher (with ``drain``,
+        queued requests are served first)."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            sets = [s for s in (self._primary, self._canary)
+                    if s is not None]
+        for s in sets:
+            s.close(drain=drain)
+
+
+class ModelManager:
+    """Zero-downtime model swaps for one Fleet.
+
+    ``reload(path)`` loads the model file, freezes a CompiledForest with
+    the fleet's bucket ladder, and promotes it — all serialized under
+    one lock so two concurrent ``POST /reload``s cannot interleave their
+    swaps.  ``loader`` is injectable for tests (and for callers that
+    already hold a booster)."""
+
+    def __init__(self, fleet: Fleet,
+                 loader: Optional[Callable[[str], Any]] = None,
+                 params: Optional[Dict[str, Any]] = None,
+                 buckets: Optional[Sequence[int]] = None):
+        self.fleet = fleet
+        self._loader = loader or self._load_model_file
+        self._params = dict(params or {})
+        self._buckets = list(buckets) if buckets else None
+        self._reload_lock = threading.Lock()
+
+    def _load_model_file(self, path: str):
+        from ..basic import Booster
+        from .forest import CompiledForest
+
+        booster = Booster(params=dict(self._params), model_file=path)
+        buckets = self._buckets
+        if buckets is None:
+            # mirror the fleet's live ladder so the new generation warms
+            # exactly the buckets requests will route to
+            buckets = list(self.fleet.primary_forest.ladder.sizes)
+        return CompiledForest.from_booster(booster, buckets=buckets)
+
+    def reload(self, model_path: str, target: str = "primary") -> int:
+        """Hot-swap ``target`` to the model at ``model_path``; returns
+        the new generation id once the old generation has drained."""
+        with self._reload_lock:
+            with obs.span("Serve::reload"):
+                t0 = time.perf_counter()
+                forest = self._loader(model_path)
+                new_set = self.fleet.promote(forest, target=target,
+                                             model_path=str(model_path))
+                log.info("serve: reload of %s -> generation %d took %.2fs",
+                         model_path, new_set.generation,
+                         time.perf_counter() - t0)
+            return new_set.generation

@@ -196,6 +196,9 @@ class CompiledForest:
         self._tree_dev = tuple(
             jnp.asarray(np.stack([s[i] for s in stacks], axis=0))
             for i in range(6))
+        # default placement (first local device); serve/fleet.py pins
+        # per-replica copies with to_device()
+        self.device = None
         obs.inc("forest_compile_artifacts")
         obs.set_gauge("forest_trees", int(n_models))
         obs.set_gauge("forest_leaves_padded", int(self.num_leaves))
@@ -374,6 +377,31 @@ class CompiledForest:
         return self._device_scores
 
     # ------------------------------------------------------------------
+    def to_device(self, device) -> "CompiledForest":
+        """A copy of this forest pinned to ``device``: the SoA tree
+        stacks and cut tables are ``jax.device_put`` there explicitly,
+        and the two fused programs get FRESH jit wrappers so each
+        replica compiles (and ``warmup()``s) its own executables for its
+        own device.  Because the device arrays are committed, the
+        host-numpy request rows follow them — a hot swap that warmed the
+        new forest through this path never pays a first-request
+        cross-device transfer or compile (serve/fleet.py; the reload
+        test asserts zero post-swap compile-ledger events)."""
+        import jax
+
+        clone = object.__new__(CompiledForest)
+        clone.__dict__.update(self.__dict__)
+        clone.device = device
+        clone._tree_dev = tuple(jax.device_put(a, device)
+                                for a in self._tree_dev)
+        clone._bnd_dev = jax.device_put(self._bnd_dev, device)
+        clone._cats_dev = jax.device_put(self._cats_dev, device)
+        clone._is_cat_dev = jax.device_put(self._is_cat_dev, device)
+        clone._binned_jit = CountingJit(clone._make_binned_fn(),
+                                        "predict_forest")
+        clone._raw_jit = CountingJit(clone._make_raw_fn(), "serve_forest")
+        return clone
+
     def warmup(self, buckets: Optional[Sequence[int]] = None,
                max_bucket: Optional[int] = None) -> "CompiledForest":
         """Pre-compile every bucket for both programs so the hot path
@@ -394,7 +422,7 @@ class CompiledForest:
         return self
 
     def info(self) -> Dict[str, object]:
-        return {
+        out = {
             "num_trees": int(self.num_trees),
             "num_class": int(self.num_class),
             "num_features": int(self.num_features),
@@ -403,6 +431,9 @@ class CompiledForest:
             "buckets": list(self.ladder.sizes),
             "max_cuts": int(self.max_cuts),
         }
+        if self.device is not None:
+            out["device"] = str(self.device)
+        return out
 
 
 def _zero_tree(num_leaves: int):

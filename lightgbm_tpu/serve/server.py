@@ -1,48 +1,67 @@
-"""Threaded micro-batching HTTP front end over a CompiledForest.
+"""Threaded HTTP front end over a serving Fleet of CompiledForests.
 
 ``python -m lightgbm_tpu serve input_model=model.txt serve_port=8080``
-loads a model file, freezes it into a :class:`~.forest.CompiledForest`,
-pre-compiles every bucket (``warmup()``), and serves predictions over
-plain stdlib HTTP — no framework dependency, matching the repo's
-no-new-deps rule.  Concurrent requests coalesce into device batches in
-``serve/batcher.py``'s MicroBatcher under the ``serve_max_delay_ms``
-deadline, so throughput scales with concurrency while p99 stays bounded.
+loads a model file, freezes it into one
+:class:`~.forest.CompiledForest` PER local device (``serve_replicas``
+caps the count), pre-compiles every bucket on every replica, and serves
+predictions over plain stdlib HTTP — no framework dependency, matching
+the repo's no-new-deps rule.  Requests are routed by
+``serve/fleet.py``'s least-loaded dispatcher and coalesce into device
+batches per replica under the ``serve_max_delay_ms`` deadline, so
+throughput scales with devices and concurrency while p99 stays bounded.
 
 Protocol (JSON in/out; CSV/TSV accepted for rows):
 
 - ``POST /predict``: body ``{"rows": [[...], ...], "raw_score": false}``
   or ``text/csv`` lines of feature values.  Response
-  ``{"predictions": [...], "num_rows": n}`` — one float per row, or one
-  list of ``num_class`` floats per row for multiclass.
-- ``GET /healthz``: liveness + frozen-forest shape info.
+  ``{"predictions": [...], "num_rows": n, "model": ..., "generation":
+  g, "replica": r}`` — predictions are one float per row, or one list
+  of ``num_class`` floats per row for multiclass; model/generation/
+  replica say exactly which forest served it (hot reloads bump the
+  generation).
+- ``POST /reload``: body ``{"model": "<path>", "target": "primary"}`` —
+  zero-downtime hot swap: the new model builds and warms OFF the
+  serving path, swaps in atomically, and the old generation drains
+  (in-flight requests finish on the forest they started on).  Responds
+  with the new generation id once the drain completes.
+- ``GET /healthz``: liveness + frozen-forest shape info + generation.
 - ``GET /stats``: the FULL obs registry snapshot as JSON — every
-  counter, every numeric gauge, and per-histogram summaries
-  (count/sum/p50/p99); new metric names appear here automatically
-  instead of drifting out of a hand-picked key list.
+  counter, every numeric gauge, per-histogram summaries
+  (count/sum/p50/p99) — plus the fleet topology (per-replica queue
+  depth, in-flight, EWMA service time, generations).
 - ``GET /metrics``: the same registry in Prometheus text exposition
   0.0.4 (``lightgbm_tpu_`` namespace, obs/prom.py) for standard
-  scrapers — including the ``serve_latency_seconds`` histogram the
-  micro-batcher feeds per request.
+  scrapers — including the ``serve_latency_seconds`` histogram and its
+  per-``model=`` labeled variants.
+
+Overload: bounded per-replica queues + a fleet-wide in-flight cap shed
+excess load as ``429`` with a ``Retry-After`` computed from the
+observed p50 service time (``serve_shed_total`` counts them).  EVERY
+response — success, shed, bad input, timeout — echoes ``X-Request-Id``
+and closes its ``Serve::request`` trace span, so a client-held id is
+always findable in the causal trace export.
 
 Shutdown is graceful: SIGINT/SIGTERM (or ``PredictServer.stop()``)
-stops accepting, drains queued requests through the batcher, then joins
-the HTTP threads.
+stops accepting, drains every replica's batcher, then joins the HTTP
+threads.
 """
 
 from __future__ import annotations
 
 import itertools
 import json
+import math
 import signal
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Mapping, Optional
 
 import numpy as np
 
 from .. import obs
 from ..utils import log
-from .batcher import MicroBatcher
+from ..utils.log import LightGBMError
+from .fleet import Fleet, ModelManager, Overloaded
 from .forest import CompiledForest
 
 # monotonically increasing request ids: echoed in the X-Request-Id
@@ -122,25 +141,32 @@ class _Handler(BaseHTTPRequestHandler):
         log.debug("serve: " + fmt, *args)
 
     def _reply(self, code: int, payload: dict,
-               request_id: Optional[int] = None) -> None:
+               request_id: Optional[int] = None,
+               headers: Optional[Mapping[str, str]] = None) -> None:
         body = json.dumps(payload).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         if request_id is not None:
             self.send_header("X-Request-Id", str(request_id))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 - stdlib handler naming
         srv: "PredictServer" = self.server.predict_server
+        req_id = next(_request_ids)
         if self.path == "/healthz":
-            self._reply(200, {"status": "ok", **srv.forest.info()})
+            self._reply(200, {"status": "ok",
+                              "generation": srv.fleet.generation,
+                              **srv.forest.info()}, req_id)
         elif self.path == "/stats":
             # the WHOLE registry, not a hand-picked key list: new metric
             # names (histogram series included) surface here without this
             # handler ever learning about them
-            self._reply(200, registry_stats())
+            self._reply(200, {**registry_stats(),
+                              "fleet": srv.fleet.stats()}, req_id)
         elif self.path == "/metrics":
             from ..obs import prom
             from ..obs.metrics_server import rank_labels
@@ -148,21 +174,30 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_response(200)
             self.send_header("Content-Type", prom.CONTENT_TYPE)
             self.send_header("Content-Length", str(len(body)))
+            self.send_header("X-Request-Id", str(req_id))
             self.end_headers()
             self.wfile.write(body)
         else:
-            self._reply(404, {"error": f"unknown path {self.path}"})
+            self._reply(404, {"error": f"unknown path {self.path}"},
+                        req_id)
 
     def do_POST(self):  # noqa: N802 - stdlib handler naming
         srv: "PredictServer" = self.server.predict_server
-        if self.path != "/predict":
-            self._reply(404, {"error": f"unknown path {self.path}"})
-            return
         req_id = next(_request_ids)
+        if self.path == "/reload":
+            self._do_reload(srv, req_id)
+            return
+        if self.path != "/predict":
+            self._reply(404, {"error": f"unknown path {self.path}"}, req_id)
+            return
         # causal-trace root: one trace per HTTP request.  Everything the
-        # request causes (queue wait, the coalesced batch it rides, the
-        # device predict) hangs off this span in the trace export.
-        with obs.trace_span("Serve::request", args={"request_id": req_id}):
+        # request causes (dispatch, queue wait, the coalesced batch it
+        # rides, the device predict) hangs off this span in the trace
+        # export; the context manager closes it on EVERY exit path —
+        # shed, bad input and timeout responses included (pinned by
+        # tests/test_fleet.py).
+        with obs.trace_span("Serve::request",
+                            args={"request_id": req_id}) as rh:
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
@@ -173,51 +208,119 @@ class _Handler(BaseHTTPRequestHandler):
                 # its batch
                 if rows.shape[0] == 0:
                     raise ValueError("no rows in request")
-                if rows.shape[1] != srv.forest.num_features:
+                if rows.shape[1] != srv.fleet.num_features:
                     raise ValueError(
-                        f"expected {srv.forest.num_features} features per "
+                        f"expected {srv.fleet.num_features} features per "
                         f"row, got {rows.shape[1]}")
             except Exception as exc:
                 obs.inc("serve_bad_requests")
+                if rh is not None:
+                    rh.args["status"] = 400
                 self._reply(400, {"error": f"bad request: {exc}"}, req_id)
                 return
+            status = 500
             try:
-                raw, out = srv.batcher.submit(rows,
-                                              timeout=srv.request_timeout)
+                res = srv.fleet.submit(rows, timeout=srv.request_timeout)
+                status = 200
                 self._reply(200, {
-                    "predictions": _json_predictions(raw, out, raw_score),
+                    "predictions": _json_predictions(res.raw, res.out,
+                                                     raw_score),
                     "num_rows": int(rows.shape[0]),
                     "request_id": req_id,
+                    "model": res.model,
+                    "generation": res.generation,
+                    "replica": res.replica,
                 }, req_id)
+            except Overloaded as exc:
+                # admission control shed: bend p99, don't break it.  The
+                # Retry-After hint is the observed p50 service time —
+                # integral seconds per RFC 9110, never below 1.
+                status = 429
+                retry = max(1, int(math.ceil(exc.retry_after_s)))
+                self._reply(429, {"error": f"overloaded: {exc}",
+                                  "retry_after_s": retry}, req_id,
+                            headers={"Retry-After": retry})
             except TimeoutError:
+                status = 503
                 obs.inc("serve_timeouts")
                 self._reply(503, {"error": "prediction timed out"}, req_id)
             except RuntimeError:
-                # batcher closed: mid graceful shutdown — retryable
+                # fleet/batcher closed: mid graceful shutdown — retryable
+                status = 503
                 obs.inc("serve_shedding")
                 self._reply(503, {"error": "server shutting down"}, req_id)
             except Exception as exc:
                 obs.inc("serve_errors")
                 self._reply(500, {"error": str(exc)}, req_id)
+            finally:
+                if rh is not None:
+                    rh.args["status"] = status
+
+    def _do_reload(self, srv: "PredictServer", req_id: int) -> None:
+        """``POST /reload {"model": path[, "target": "primary"]}`` —
+        zero-downtime hot swap via the ModelManager; replies with the
+        new generation once the old one has drained."""
+        with obs.trace_span("Serve::request",
+                            args={"request_id": req_id,
+                                  "path": "/reload"}) as rh:
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                model = (payload or {}).get("model", "")
+                target = (payload or {}).get("target", "primary")
+                if not model:
+                    raise ValueError('body must carry {"model": "<path>"}')
+            except Exception as exc:
+                obs.inc("serve_bad_requests")
+                if rh is not None:
+                    rh.args["status"] = 400
+                self._reply(400, {"error": f"bad request: {exc}"}, req_id)
+                return
+            try:
+                gen = srv.manager.reload(str(model), target=str(target))
+                if rh is not None:
+                    rh.args["status"] = 200
+                self._reply(200, {"status": "ok", "generation": gen,
+                                  "target": str(target),
+                                  "request_id": req_id}, req_id)
+            except (OSError, ValueError, LightGBMError) as exc:
+                # client-side rejections: missing/bad model file, width
+                # mismatch vs the other live model, no canary slot — a
+                # retry of the same request cannot succeed, so 400
+                if rh is not None:
+                    rh.args["status"] = 400
+                self._reply(400, {"error": f"reload failed: {exc}"}, req_id)
+            except Exception as exc:
+                obs.inc("serve_errors")
+                if rh is not None:
+                    rh.args["status"] = 500
+                self._reply(500, {"error": f"reload failed: {exc}"}, req_id)
 
 
 class PredictServer:
-    """Own the HTTP listener + micro-batcher around one CompiledForest.
+    """Own the HTTP listener + dispatch fleet.
 
-    ``start()`` binds and serves on a daemon thread (port 0 picks an
-    ephemeral port — tests use this); ``serve_forever()`` blocks with
-    SIGINT/SIGTERM wired to a graceful stop.
+    Accepts either a ready :class:`~.fleet.Fleet` or a bare
+    :class:`CompiledForest` (wrapped as a single-replica fleet with the
+    pre-fleet defaults: unbounded queue, no in-flight cap).  ``start()``
+    binds and serves on a daemon thread (port 0 picks an ephemeral port
+    — tests use this); ``serve_forever()`` blocks with SIGINT/SIGTERM
+    wired to a graceful stop.
     """
 
-    def __init__(self, forest: CompiledForest, host: str = "127.0.0.1",
+    def __init__(self, forest, host: str = "127.0.0.1",
                  port: int = 8080, max_batch: int = 8192,
                  max_delay_ms: float = 5.0,
-                 request_timeout: float = 60.0):
-        self.forest = forest
+                 request_timeout: float = 60.0,
+                 params: Optional[dict] = None):
+        if isinstance(forest, Fleet):
+            self.fleet = forest
+        else:
+            self.fleet = Fleet.from_forest(
+                forest, max_batch=max_batch,
+                max_delay_s=max_delay_ms / 1000.0)
+        self.manager = ModelManager(self.fleet, params=params)
         self.request_timeout = float(request_timeout)
-        self.batcher = MicroBatcher(forest.batched_fn(),
-                                    max_batch=max_batch,
-                                    max_delay_s=max_delay_ms / 1000.0)
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.daemon_threads = True
         self.httpd.predict_server = self
@@ -225,6 +328,13 @@ class PredictServer:
         self._stop_requested = threading.Event()
         self._stop_lock = threading.Lock()
         self._stopped = False
+
+    @property
+    def forest(self) -> CompiledForest:
+        """The primary generation's replica-0 forest (healthz info,
+        width checks) — kept as an attribute-compatible view of the
+        pre-fleet single-forest server."""
+        return self.fleet.primary_forest
 
     @property
     def address(self):
@@ -236,13 +346,16 @@ class PredictServer:
                                         name="lgbt-serve-http", daemon=True)
         self._thread.start()
         host, port = self.address
+        st = self.fleet.stats()
         log.info("serving CompiledForest (%d trees, %d class) on "
-                 "http://%s:%d", self.forest.num_trees,
-                 self.forest.num_class, host, port)
+                 "http://%s:%d — %d replica(s), generation %d",
+                 self.forest.num_trees, self.forest.num_class, host, port,
+                 len(st["replicas"]), st["generation"])
         return self
 
     def stop(self) -> None:
-        """Graceful: stop accepting, drain the batcher, close sockets."""
+        """Graceful: stop accepting, drain every replica's batcher,
+        close sockets."""
         self._stop_requested.set()
         with self._stop_lock:
             if self._stopped:
@@ -251,14 +364,16 @@ class PredictServer:
         self.httpd.shutdown()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
-        self.batcher.close(drain=True)
+        self.fleet.close(drain=True)
         self.httpd.server_close()
         # flush the causal trace AFTER the drain so the last batch's
         # spans are in the export
         obs.TRACER.maybe_export()
-        log.info("serve: shut down cleanly (%d requests, %d batches)",
+        log.info("serve: shut down cleanly (%d requests, %d batches, "
+                 "%d shed)",
                  obs.get_counter("serve_requests"),
-                 obs.get_counter("serve_batches"))
+                 obs.get_counter("serve_batches"),
+                 obs.get_counter("serve_shed_total"))
 
     def serve_forever(self) -> None:
         """Block until SIGINT/SIGTERM, then shut down gracefully.  The
@@ -285,12 +400,16 @@ class PredictServer:
 
 
 def serve_from_config(config, params=None) -> PredictServer:
-    """CLI entry (``task=serve``): load ``input_model``, freeze, warm up
-    every bucket up to ``serve_max_batch``, and return a started server
-    (the CLI then blocks in ``serve_forever``)."""
+    """CLI entry (``task=serve``): load ``input_model``, freeze one
+    forest per device (``serve_replicas`` caps the count), warm every
+    bucket up to ``serve_max_batch`` on every replica, and return a
+    started server (the CLI then blocks in ``serve_forever``).
+    ``serve_canary_model`` adds a second model at
+    ``serve_canary_weight`` traffic share."""
     from ..basic import Booster
 
     from .batcher import default_ladder
+    from .fleet import fleet_devices
 
     if not config.input_model:
         log.fatal("No model file specified (input_model=...)")
@@ -301,8 +420,6 @@ def serve_from_config(config, params=None) -> PredictServer:
     compile_ledger.configure(config.compile_ledger_file or None)
     memwatch.configure(config.memwatch)
     obs.TRACER.configure(config.trace_events_file or None)
-    booster = Booster(params=dict(params or {}),
-                      model_file=config.input_model)
     # Cap the ladder at serve_max_batch: warmup() compiles every bucket
     # the forest can ever pick, so an oversize request streams through
     # the largest WARMED bucket instead of jit-compiling an unwarmed one
@@ -310,13 +427,33 @@ def serve_from_config(config, params=None) -> PredictServer:
     max_batch = int(config.serve_max_batch)
     buckets = list(config.predict_buckets) or default_ladder()
     buckets = [b for b in buckets if b <= max_batch] or [max_batch]
-    forest = CompiledForest.from_booster(booster, buckets=buckets)
-    log.info("serve: warming %d bucket(s) for %d trees...",
-             len(forest.ladder.sizes), forest.num_trees)
-    forest.warmup()
+
+    def _freeze(path):
+        booster = Booster(params=dict(params or {}), model_file=path)
+        return CompiledForest.from_booster(booster, buckets=buckets)
+
+    forest = _freeze(config.input_model)
+    canary = None
+    canary_path = str(getattr(config, "serve_canary_model", "") or "")
+    if canary_path:
+        canary = _freeze(canary_path)
+    devices = fleet_devices(int(getattr(config, "serve_replicas", 0)))
+    log.info("serve: warming %d bucket(s) for %d trees on %d replica(s)%s"
+             "...", len(forest.ladder.sizes), forest.num_trees,
+             len(devices), " + canary" if canary is not None else "")
+    fleet = Fleet.build(
+        forest, devices=devices,
+        canary_forest=canary,
+        canary_weight=float(getattr(config, "serve_canary_weight", 0.0)),
+        max_batch=max_batch,
+        max_delay_s=float(config.serve_max_delay_ms) / 1000.0,
+        max_queue=int(getattr(config, "serve_queue_depth", 0)),
+        max_inflight=int(getattr(config, "serve_max_inflight", 0)),
+        warm=True)
     return PredictServer(
-        forest,
+        fleet,
         host=str(getattr(config, "serve_host", "127.0.0.1") or "127.0.0.1"),
         port=int(config.serve_port),
-        max_batch=int(config.serve_max_batch),
-        max_delay_ms=float(config.serve_max_delay_ms))
+        max_batch=max_batch,
+        max_delay_ms=float(config.serve_max_delay_ms),
+        params=dict(params or {}))

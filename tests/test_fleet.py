@@ -1,0 +1,566 @@
+"""Serving fleet (lightgbm_tpu/serve/fleet.py).
+
+Tier-1 CPU tests for the fleet layer: least-loaded dispatch under
+skewed per-replica load, zero-downtime hot reload while clients hammer
+``/predict`` (zero failed requests, old generation drains, predictions
+bit-match the generation that served them, ZERO post-swap XLA compiles
+asserted via the compile ledger), admission control (429 + sane
+``Retry-After``, admitted-request p99 bounded), canary A/B split with
+per-``model=`` metric labels parsed via ``obs/prom.py``, and the
+request-id/trace-span guarantees on every error path.
+
+Stub forests (constant predictions, controllable service time) drive
+the scheduling/overload tests so they are deterministic and fast; the
+hot-reload and warmup tests run real ``CompiledForest``s.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import compile_ledger, prom, tracing
+from lightgbm_tpu.serve import (Fleet, ModelManager, Overloaded,
+                                PredictServer, Replica, ReplicaSet)
+from lightgbm_tpu.serve.forest import CompiledForest
+
+pytestmark = [pytest.mark.serve, pytest.mark.fleet]
+
+BUCKETS = [16, 64]
+
+
+@pytest.fixture
+def tracer(tmp_path, monkeypatch):
+    """Arm the process tracer (same pattern as tests/test_tracing.py)."""
+    path = tmp_path / "trace_events.json"
+    tracing.TRACER.reset()
+    monkeypatch.setenv(tracing.ENV_PATH, str(path))
+    tracing.TRACER.configure()
+    yield path
+    tracing.TRACER.disable()
+    tracing.TRACER.reset()
+    tracing.TRACER.path = None
+
+
+class StubForest:
+    """Duck-typed CompiledForest: constant predictions, fixed service
+    time — deterministic fuel for dispatch/admission tests."""
+
+    num_trees = 1
+    num_class = 1
+
+    def __init__(self, service_s=0.0, value=1.0, num_features=4,
+                 device=None):
+        self.service_s = float(service_s)
+        self.value = float(value)
+        self.num_features = int(num_features)
+        self.device = device
+
+    def batched_fn(self):
+        def fn(rows):
+            if self.service_s:
+                time.sleep(self.service_s)
+            out = np.full((1, rows.shape[0]), self.value, np.float32)
+            return out, out
+        return fn
+
+    def to_device(self, device):
+        return StubForest(self.service_s, self.value, self.num_features,
+                          device)
+
+    def warmup(self, buckets=None, max_bucket=None):
+        return self
+
+    def info(self):
+        return {"num_trees": 1, "num_class": 1,
+                "num_features": self.num_features}
+
+
+def _stub_replicas(service_times, model="primary", generation=1,
+                   max_queue=0, value=1.0):
+    return [Replica(StubForest(s, value=value), i, model, generation,
+                    max_batch=256, max_delay_s=0.0, max_queue=max_queue)
+            for i, s in enumerate(service_times)]
+
+
+def _train_and_save(tmp_path, name, rounds, lr=0.1, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(800, 6))
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "min_data_in_leaf": 20, "learning_rate": lr},
+                    lgb.Dataset(X, label=y), num_boost_round=rounds)
+    path = str(tmp_path / name)
+    bst.save_model(path)
+    return path, X
+
+
+def _post(base, path, payload, timeout=60):
+    body = (payload if isinstance(payload, bytes)
+            else json.dumps(payload).encode())
+    req = urllib.request.Request(base + path, data=body,
+                                 headers={"Content-Type":
+                                          "application/json"})
+    resp = urllib.request.urlopen(req, timeout=timeout)
+    return json.loads(resp.read()), dict(resp.headers)
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+
+
+def test_least_loaded_dispatch_skews_toward_fast_replica():
+    """A 10x-slower replica must organically receive far less traffic:
+    the load score is outstanding work x EWMA service time."""
+    slow, fast = _stub_replicas([0.05, 0.005])
+    fleet = Fleet(ReplicaSet([slow, fast], "primary", 1))
+    stop = time.monotonic() + 1.5
+
+    def client():
+        while time.monotonic() < stop:
+            res = fleet.submit(np.ones((2, 4), np.float32), timeout=10.0)
+            assert res.generation == 1 and res.model == "primary"
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    fleet.close()
+    assert fast.requests > 2 * slow.requests, \
+        (slow.requests, fast.requests)
+    st = fleet.stats()
+    assert {r["replica"] for r in st["replicas"]} == {0, 1}
+    assert all(r["inflight"] == 0 for r in st["replicas"])
+
+
+def test_fleet_submit_after_close_raises():
+    fleet = Fleet(ReplicaSet(_stub_replicas([0.0]), "primary", 1))
+    fleet.close()
+    with pytest.raises(RuntimeError):
+        fleet.submit(np.ones((1, 4), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# admission control
+
+
+def test_inflight_cap_sheds_with_retry_hint():
+    reps = _stub_replicas([0.1, 0.1], max_queue=8)
+    fleet = Fleet(ReplicaSet(reps, "primary", 1), max_inflight=2)
+    before = obs.get_counter("serve_shed_total")
+    before_lbl = obs.get_counter(
+        obs.labeled_name("serve_shed_total", model="primary"))
+    shed, ok = [], []
+
+    def client():
+        for _ in range(6):
+            try:
+                fleet.submit(np.ones((1, 4), np.float32), timeout=10.0)
+                ok.append(1)
+            except Overloaded as exc:
+                assert exc.retry_after_s > 0
+                shed.append(1)
+                time.sleep(0.01)
+
+    threads = [threading.Thread(target=client) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    fleet.close()
+    assert shed and ok, (len(shed), len(ok))
+    delta = obs.get_counter("serve_shed_total") - before
+    assert delta == len(shed)
+    # ... and the same count landed in the model= labeled series
+    assert obs.get_counter(obs.labeled_name(
+        "serve_shed_total", model="primary")) - before_lbl == len(shed)
+
+
+def test_bounded_replica_queue_sheds():
+    """serve_queue_depth -> MicroBatcher(max_queue): with one replica
+    wedged, the queue bound converts pile-up into Overloaded."""
+    (rep,) = _stub_replicas([0.2], max_queue=1)
+    fleet = Fleet(ReplicaSet([rep], "primary", 1))
+    outcomes = []
+
+    def client():
+        try:
+            fleet.submit(np.ones((1, 4), np.float32), timeout=10.0)
+            outcomes.append("ok")
+        except Overloaded:
+            outcomes.append("shed")
+
+    threads = [threading.Thread(target=client) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    fleet.close()
+    assert "shed" in outcomes and "ok" in outcomes, outcomes
+
+
+def test_overload_http_429_retry_after_and_bounded_p99():
+    """The overload acceptance gate: at ~4x capacity, shed requests get
+    429 + integral Retry-After >= 1, and the p99 of ADMITTED requests
+    (read from the model-labeled serve_latency_seconds histogram)
+    stays within 2x the unloaded p99 — admission control bends the
+    tail instead of letting the queue stretch it."""
+    model = "p99stub"
+    reps = [Replica(StubForest(0.15), i, model, 1, max_batch=256,
+                    max_delay_s=0.0, max_queue=8) for i in range(2)]
+    fleet = Fleet(ReplicaSet(reps, model, 1), max_inflight=2)
+    srv = PredictServer(fleet, port=0).start()
+    host, port = srv.address
+    base = f"http://{host}:{port}"
+    series = obs.labeled_name("serve_latency_seconds", model=model)
+    rows = {"rows": [[0.0, 0.0, 0.0, 0.0]]}
+
+    def _hist_delta(h1, h0):
+        counts0 = h0["counts"] if h0 else [0] * len(h1["counts"])
+        return {"buckets": h1["buckets"],
+                "counts": [a - b for a, b in zip(h1["counts"], counts0)],
+                "sum": h1["sum"] - (h0["sum"] if h0 else 0.0),
+                "count": h1["count"] - (h0["count"] if h0 else 0)}
+
+    try:
+        # unloaded phase: sequential requests
+        h0 = obs.get_histogram(series)
+        for _ in range(8):
+            _post(base, "/predict", rows)
+        h_unloaded = _hist_delta(obs.get_histogram(series), h0)
+        p99_unloaded = obs.histogram_quantile(h_unloaded, 0.99)
+
+        # loaded phase: ~4x capacity
+        h1 = obs.get_histogram(series)
+        sheds, retry_afters = [], []
+
+        def client():
+            admitted = attempts = 0
+            while admitted < 4 and attempts < 60:
+                attempts += 1
+                try:
+                    _, hdrs = _post(base, "/predict", rows)
+                    admitted += 1
+                except urllib.error.HTTPError as err:
+                    assert err.code == 429, err.code
+                    ra = err.headers.get("Retry-After")
+                    assert ra is not None, "429 without Retry-After"
+                    retry_afters.append(int(ra))
+                    assert err.headers.get("X-Request-Id") is not None
+                    sheds.append(1)
+                    err.read()
+                    time.sleep(0.02)
+
+        threads = [threading.Thread(target=client) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        h_loaded = _hist_delta(obs.get_histogram(series), h1)
+        p99_loaded = obs.histogram_quantile(h_loaded, 0.99)
+    finally:
+        srv.stop()
+    assert sheds, "4x capacity never shed"
+    assert all(1 <= ra <= 60 for ra in retry_afters), retry_afters
+    assert p99_unloaded is not None and p99_loaded is not None
+    assert p99_loaded <= 2.0 * p99_unloaded, \
+        f"admitted p99 {p99_loaded:.3f}s vs unloaded {p99_unloaded:.3f}s"
+
+
+# ---------------------------------------------------------------------------
+# hot reload
+
+
+def test_hot_reload_under_sustained_load(tmp_path):
+    """The reload acceptance gate: clients hammer /predict across a
+    POST /reload — zero failed requests, every response's predictions
+    bit-match the generation that served it, the old generation drains,
+    and the compile ledger records ZERO compiles after the swap (the
+    new generation warmed on its replica's device first)."""
+    import jax
+
+    path_a, X = _train_and_save(tmp_path, "a.txt", rounds=3)
+    path_b, _ = _train_and_save(tmp_path, "b.txt", rounds=6, lr=0.3)
+    rows5 = X[:5].astype(np.float32)
+
+    def _ref(path):
+        cf = CompiledForest.from_booster(lgb.Booster(model_file=path),
+                                         buckets=BUCKETS)
+        return np.asarray(cf.predict(rows5, device_binning=True),
+                          np.float32)
+
+    ref = {1: _ref(path_a), 2: _ref(path_b)}
+    assert np.abs(ref[1] - ref[2]).max() > 1e-3   # models distinguishable
+
+    forest = CompiledForest.from_booster(lgb.Booster(model_file=path_a),
+                                         buckets=BUCKETS)
+    fleet = Fleet.build(forest, devices=jax.local_devices()[:1],
+                        max_batch=64, max_delay_s=0.001, max_queue=256)
+    srv = PredictServer(fleet, port=0).start()
+    host, port = srv.address
+    base = f"http://{host}:{port}"
+    payload = {"rows": rows5.tolist()}
+
+    results, errors = [], []
+    stop_evt = threading.Event()
+
+    def hammer():
+        while not stop_evt.is_set():
+            try:
+                resp, hdrs = _post(base, "/predict", payload)
+                results.append((resp["generation"], resp["predictions"],
+                                hdrs.get("X-Request-Id")))
+            except Exception as exc:  # any failure breaks the gate
+                errors.append(repr(exc))
+                return
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.4)
+        drained_before = obs.get_counter("serve_generations_drained")
+        resp, _ = _post(base, "/reload", {"model": path_b}, timeout=180)
+        assert resp["status"] == "ok" and resp["generation"] == 2
+        n_ledger = len(compile_ledger.events())
+        time.sleep(0.4)
+    finally:
+        stop_evt.set()
+        for t in threads:
+            t.join()
+    # post-swap traffic only hits warmed programs
+    for _ in range(5):
+        resp, _ = _post(base, "/predict", payload)
+        results.append((resp["generation"], resp["predictions"], "x"))
+    stats, _ = json.loads(urllib.request.urlopen(
+        base + "/stats", timeout=30).read()), None
+    srv.stop()
+
+    assert errors == [], errors[:3]
+    gens = sorted({g for g, _, _ in results})
+    assert gens == [1, 2], gens                  # both generations served
+    for gen, preds, req_id in results:
+        assert req_id is not None
+        got = np.asarray(preds, np.float32)
+        assert np.array_equal(got, ref[gen]), \
+            f"generation {gen} response does not bit-match its forest"
+    assert len(compile_ledger.events()) == n_ledger, \
+        "XLA compiled on the serving path after the swap"
+    assert obs.get_counter("serve_generations_drained") \
+        == drained_before + 1
+    fleet_stats = stats["fleet"]
+    assert fleet_stats["generation"] == 2
+    assert all(r["generation"] == 2 for r in fleet_stats["replicas"])
+
+
+def test_reload_error_paths(tmp_path):
+    fleet = Fleet(ReplicaSet(_stub_replicas([0.0]), "primary", 1))
+    srv = PredictServer(fleet, port=0).start()
+    host, port = srv.address
+    base = f"http://{host}:{port}"
+    try:
+        for payload in ({}, {"model": str(tmp_path / "missing.txt")}):
+            req = urllib.request.Request(
+                base + "/reload", data=json.dumps(payload).encode())
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=30)
+            assert err.value.code == 400
+            assert err.value.headers.get("X-Request-Id") is not None
+            err.value.read()
+    finally:
+        srv.stop()
+
+
+def test_reload_rejects_width_mismatch():
+    fleet = Fleet(ReplicaSet(_stub_replicas([0.0]), "primary", 1),
+                  canary=ReplicaSet(_stub_replicas([0.0], model="canary",
+                                                   generation=2),
+                                    "canary", 2),
+                  canary_weight=0.5)
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError, match="request schema"):
+        fleet.promote(StubForest(num_features=9), target="primary")
+    fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# canary routing + model= labels
+
+
+def test_canary_split_and_model_labels():
+    """25% canary weight -> an exact deterministic 1-in-4 split, with
+    every serve metric labeled per model and parseable back out of the
+    Prometheus exposition (obs/prom.py)."""
+    primary = ReplicaSet(_stub_replicas([0.0], value=1.0), "primary", 1)
+    canary = ReplicaSet(_stub_replicas([0.0], model="canary",
+                                       generation=2, value=2.0),
+                        "canary", 2)
+    before = {m: obs.get_counter(obs.labeled_name("serve_requests",
+                                                  model=m))
+              for m in ("primary", "canary")}
+    fleet = Fleet(primary, canary, canary_weight=0.25)
+    n = 200
+    served = {"primary": 0, "canary": 0}
+    for _ in range(n):
+        res = fleet.submit(np.ones((1, 4), np.float32), timeout=10.0)
+        served[res.model] += 1
+        # the canary's constant prediction proves the response really
+        # came from the model it claims
+        want = 1.0 if res.model == "primary" else 2.0
+        assert float(np.asarray(res.out)[0, 0]) == want
+    fleet.close()
+    assert served["canary"] == n // 4            # deterministic rotation
+    assert served["primary"] == n - n // 4
+
+    text = prom.render()
+    parsed = prom.parse_text(text)
+    for m in ("primary", "canary"):
+        got = [v for name, labels, v in parsed["samples"]
+               if name == "lightgbm_tpu_serve_requests"
+               and labels.get("model") == m]
+        assert got, f"no model={m} labeled serve_requests sample"
+        assert got[0] - before[m] == served[m]
+        hist = prom.histogram_series(
+            parsed, "lightgbm_tpu_serve_latency_seconds",
+            match={"model": m})
+        assert hist["count"] is not None and hist["count"] >= served[m]
+
+
+# ---------------------------------------------------------------------------
+# error paths: X-Request-Id + Serve::request span closure (satellite fix)
+
+
+def test_error_responses_echo_request_id_and_close_span(tracer):
+    """Shed (429), bad input (400) and unknown-path (404) responses all
+    carry X-Request-Id, and their Serve::request spans land CLOSED in
+    the trace export with the response status recorded."""
+    (rep,) = _stub_replicas([0.3], max_queue=1)
+    fleet = Fleet(ReplicaSet([rep], "primary", 1), max_inflight=1)
+    srv = PredictServer(fleet, port=0).start()
+    host, port = srv.address
+    base = f"http://{host}:{port}"
+    codes = {}
+    try:
+        # wedge the single replica so the next requests shed
+        blocker = threading.Thread(
+            target=lambda: _post(base, "/predict",
+                                 {"rows": [[0.0] * 4]}, timeout=30))
+        blocker.start()
+        time.sleep(0.1)
+        got429 = 0
+        for _ in range(6):
+            try:
+                _post(base, "/predict", {"rows": [[0.0] * 4]}, timeout=30)
+            except urllib.error.HTTPError as err:
+                assert err.code == 429
+                assert err.headers.get("X-Request-Id") is not None
+                codes[int(err.headers["X-Request-Id"])] = 429
+                got429 += 1
+                err.read()
+        blocker.join()
+        assert got429 > 0
+        # bad input: wrong feature width
+        try:
+            _post(base, "/predict", {"rows": [[1.0, 2.0]]})
+        except urllib.error.HTTPError as err:
+            assert err.code == 400
+            assert err.headers.get("X-Request-Id") is not None
+            codes[int(err.headers["X-Request-Id"])] = 400
+            err.read()
+        # malformed body
+        try:
+            _post(base, "/predict", b"{nope")
+        except urllib.error.HTTPError as err:
+            assert err.code == 400
+            assert err.headers.get("X-Request-Id") is not None
+            codes[int(err.headers["X-Request-Id"])] = 400
+            err.read()
+    finally:
+        srv.stop()
+    assert any(c == 400 for c in codes.values())
+    events = tracing.read_trace(str(tracer))
+    spans = {e["args"]["request_id"]: e for e in events
+             if e.get("ph") == "X" and e["name"] == "Serve::request"
+             and "request_id" in (e.get("args") or {})}
+    for req_id, code in codes.items():
+        ev = spans.get(req_id)
+        assert ev is not None, \
+            f"request {req_id} ({code}) has no closed Serve::request span"
+        assert ev["args"].get("status") == code, (req_id, ev["args"])
+
+
+# ---------------------------------------------------------------------------
+# device placement (satellite fix: warmup on the target device)
+
+
+def test_to_device_copy_warms_without_hotpath_compiles(tmp_path):
+    """CompiledForest.to_device + warmup() must leave NOTHING for the
+    serving path to compile — the mechanism behind zero post-swap
+    compiles in the reload test, pinned in isolation here."""
+    import jax
+
+    path, X = _train_and_save(tmp_path, "m.txt", rounds=3)
+    base = CompiledForest.from_booster(lgb.Booster(model_file=path),
+                                       buckets=BUCKETS)
+    dev = jax.local_devices()[0]
+    rep = base.to_device(dev)
+    assert rep.device is dev
+    assert "device" in rep.info()
+    rep.warmup(max_bucket=64)
+    n_ledger = len(compile_ledger.events())
+    fn = rep.batched_fn()
+    for n in (1, 3, 16, 33, 64):
+        raw, out = fn(X[:n].astype(np.float32))
+        assert raw.shape == (1, n)
+    assert len(compile_ledger.events()) == n_ledger, \
+        "warmed to_device replica compiled on the hot path"
+    # the copy serves the same predictions as the original
+    want = base.predict(X[:20].astype(np.float32), device_binning=True)
+    got = rep.predict(X[:20].astype(np.float32), device_binning=True)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+# ---------------------------------------------------------------------------
+# bench plumbing (satellite: BENCH JSON keys)
+
+
+def test_bench_regress_accepts_fleet_keys(tmp_path, capsys):
+    """Old baseline (no fleet keys) vs new candidate (with them) must
+    compare cleanly, and the fleet curve rides into the verdict."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import bench_regress
+    finally:
+        sys.path.pop(0)
+    baseline = {"metric": "serve_rows_per_sec_x", "value": 1000.0,
+                "unit": "rows/sec", "warmup_s": 10.0}
+    candidate = {"metric": "serve_rows_per_sec_x", "value": 1100.0,
+                 "unit": "rows/sec", "warmup_s": 9.0,
+                 "concurrency": 4,
+                 "fleet": {"1": {"rows_per_sec": 500.0, "shed_rate": 0.0},
+                           "2": {"rows_per_sec": 900.0,
+                                 "shed_rate": 0.01}}}
+    b = tmp_path / "base.json"
+    c = tmp_path / "cand.json"
+    b.write_text(json.dumps(baseline))
+    c.write_text(json.dumps(candidate))
+    rc = bench_regress.main(["--baseline", str(b), "--candidate", str(c),
+                             "--threshold", "5",
+                             "--warmup-threshold", "50"])
+    assert rc == 0
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["ok"]
+    assert verdict["fleet_candidate_rows_per_sec"] == {"1": 500.0,
+                                                       "2": 900.0}
+    assert verdict["fleet_candidate_shed_rate"] == {"2": 0.01}
+    assert "fleet_baseline_rows_per_sec" not in verdict

@@ -40,21 +40,40 @@ def _get(url: str, timeout=30):
 
 
 def _assert_valid_histograms(text: str):
-    """Parse an exposition; for every histogram family assert cumulative
-    buckets are monotone and the +Inf bucket equals _count.  Returns the
-    parsed structure and the set of histogram family names."""
+    """Parse an exposition; for every histogram family and every LABEL
+    SET within it (the fleet's ``model=`` dimension renders labeled and
+    unlabeled series in one family) assert cumulative buckets are
+    monotone and the +Inf bucket equals _count.  Returns the parsed
+    structure and the set of histogram family names."""
     parsed = prom.parse_text(text)
     families = {name for name, t in parsed["types"].items()
                 if t == "histogram"}
     assert families, "exposition carries no histogram"
     for fam in families:
-        h = prom.histogram_series(parsed, fam)
-        assert h["count"] is not None and h["sum"] is not None, fam
-        values = [v for _, v in h["buckets"]]
-        assert values == sorted(values), f"{fam}: non-monotone buckets"
-        assert h["buckets"][-1][0] == float("inf"), fam
-        assert h["buckets"][-1][1] == h["count"], \
-            f"{fam}: +Inf bucket != _count"
+        # one label group = the exact non-le label set of a _count line
+        groups = [labels for name, labels, _ in parsed["samples"]
+                  if name == fam + "_count"]
+        assert groups, f"{fam}: no _count sample"
+        for want in groups:
+            buckets, cnt, total = [], None, None
+            for name, labels, value in parsed["samples"]:
+                nle = {k: v for k, v in labels.items() if k != "le"}
+                if nle != want:
+                    continue
+                if name == fam + "_bucket":
+                    buckets.append((prom._parse_value(labels["le"]), value))
+                elif name == fam + "_count":
+                    cnt = value
+                elif name == fam + "_sum":
+                    total = value
+            assert cnt is not None and total is not None, (fam, want)
+            buckets.sort(key=lambda t: t[0])
+            values = [v for _, v in buckets]
+            assert values == sorted(values), \
+                f"{fam}{want}: non-monotone buckets"
+            assert buckets[-1][0] == float("inf"), (fam, want)
+            assert buckets[-1][1] == cnt, \
+                f"{fam}{want}: +Inf bucket != _count"
     return parsed, families
 
 
@@ -320,9 +339,12 @@ def test_serve_metrics_and_full_stats():
         assert h["count"] >= 3
 
         # /stats is the FULL registry snapshot: counters + gauges +
-        # histogram summaries, so new metric names can never drift out
+        # histogram summaries — plus the fleet topology (round 8) — so
+        # new metric names can never drift out
         stats = json.loads(_get(base + "/stats")[0])
-        assert set(stats) == {"counters", "gauges", "histograms"}
+        assert set(stats) == {"counters", "gauges", "histograms", "fleet"}
+        assert stats["fleet"]["generation"] >= 1
+        assert stats["fleet"]["replicas"], "fleet topology missing"
         assert stats["counters"]["serve_requests"] >= 3
         # non-serve counters appear too (full snapshot, not hand-picked)
         assert "iterations" in stats["counters"]

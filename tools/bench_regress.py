@@ -41,6 +41,11 @@ Mind the variance notes in docs/BENCH_NOTES_r03.md: the shared device
 measured 5.9-7.5 it/s for identical code across a day, so gate with a
 threshold wider than the observed window spread (the JSON's ``spread``
 tail comment) or on a quiet runner.
+
+Round 8's ``bench.py --mode predict --concurrency N`` adds ``fleet`` /
+``concurrency`` keys (per-replica-count rows/sec + shed rate); they pass
+through into the verdict informationally on whichever side carries them
+and are never required — old baselines keep comparing.
 """
 
 from __future__ import annotations
@@ -147,6 +152,23 @@ def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
             if obj.get("warmup_warm_s") is not None:
                 verdict[f"warmup_warm_{side}_s"] = float(obj["warmup_warm_s"])
         verdict["ok"] = verdict["ok"] and verdict["warmup_ok"]
+    # informational: the serving-fleet scaling curve (round 8's
+    # ``bench.py --mode predict --concurrency N`` adds ``fleet`` /
+    # ``concurrency`` keys) rides along in the verdict per side when
+    # present — not gated (replica counts vary per box), never an error
+    # when absent (pre-r08 baselines)
+    for side, obj in (("baseline", baseline), ("candidate", candidate)):
+        fleet = obj.get("fleet")
+        if isinstance(fleet, dict) and fleet:
+            verdict[f"fleet_{side}_rows_per_sec"] = {
+                r: blk.get("rows_per_sec")
+                for r, blk in sorted(fleet.items(),
+                                     key=lambda kv: int(kv[0]))
+                if isinstance(blk, dict)}
+            shed = {r: blk.get("shed_rate") for r, blk in fleet.items()
+                    if isinstance(blk, dict) and blk.get("shed_rate")}
+            if shed:
+                verdict[f"fleet_{side}_shed_rate"] = shed
     return verdict
 
 
