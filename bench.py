@@ -190,6 +190,12 @@ def predict_main(concurrency: int = 0) -> None:
             "p99_ms": round(float(np.percentile(lat, 99)), 3),
         }
     top = batches[str(max(sizes))]
+    # availability bill over the fleet run (round 9, serve/health.py):
+    # hedged retries / ejections / deadline sheds as counter deltas —
+    # informational BENCH keys, passed through by bench_regress
+    _avail_keys = ("serve_retries_total", "serve_ejections_total",
+                   "serve_deadline_expired_total", "serve_shed_total")
+    avail0 = {k: obs.get_counter(k) for k in _avail_keys}
     fleet = _fleet_scaling(booster, X32, concurrency) if concurrency \
         else None
     from lightgbm_tpu.obs import compile_ledger
@@ -206,6 +212,8 @@ def predict_main(concurrency: int = 0) -> None:
     if fleet is not None:
         result["concurrency"] = concurrency
         result["fleet"] = fleet
+        result["availability"] = {
+            k: obs.get_counter(k) - avail0[k] for k in _avail_keys}
     print(json.dumps(result))
     c = obs.snapshot()["counters"]
     tail = ""

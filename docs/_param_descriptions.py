@@ -50,6 +50,26 @@ DESC = {
                           "routing; metrics labeled model=canary)",
     "serve_canary_weight": "task=serve: canary traffic share in [0, 1) — "
                            "deterministic rotation, exact split",
+    "serve_retry_limit": "task=serve: hedged retries per request onto a "
+                         "different replica after a replica-attributable "
+                         "failure (0 = none; serve/health.py, "
+                         "docs/FAULT_TOLERANCE.md §Serving)",
+    "serve_error_threshold": "task=serve: consecutive request errors "
+                             "before a replica is marked suspect (the "
+                             "watchdog then ejects it)",
+    "serve_watchdog_ms": "task=serve: replica health watchdog interval — "
+                         "ejection, synthetic probes, re-admission "
+                         "(0 disables the whole health machine)",
+    "serve_stall_ms": "task=serve: how long a replica's worker may sit "
+                      "inside one device batch before it counts as "
+                      "wedged (stall detector; 0 = off)",
+    "serve_latency_outlier": "task=serve: EWMA service-time multiple of "
+                             "the fleet median beyond which a replica is "
+                             "a straggler (suspect after 2 ticks)",
+    "serve_state_file": "task=serve: JSON file recording the last-good "
+                        "model per slot after each successful reload; a "
+                        "restarted server boots it instead of "
+                        "input_model (crash restore)",
     "events_file": "per-iteration JSONL telemetry stream path "
                    "(docs/OBSERVABILITY.md; --events-file on the CLI)",
     "trace_dir": "device trace output dir; LIGHTGBM_TPU_TRACE_DIR env "

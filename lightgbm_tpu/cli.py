@@ -164,7 +164,10 @@ def main(argv=None) -> int:
               "[serve_port=<p> serve_max_batch=<n> serve_max_delay_ms=<ms> "
               "serve_replicas=<k> serve_queue_depth=<n> "
               "serve_max_inflight=<n> "
-              "serve_canary_model=<model> serve_canary_weight=<w>]\n"
+              "serve_canary_model=<model> serve_canary_weight=<w> "
+              "serve_retry_limit=<n> serve_watchdog_ms=<ms> "
+              "serve_error_threshold=<n> serve_stall_ms=<ms> "
+              "serve_latency_outlier=<x> serve_state_file=<json>]\n"
               "       python -m lightgbm_tpu obs-report <events.jsonl ...> "
               "[--format=json|table] [--top=K] [--compile=<ledger.jsonl>]\n"
               "       python -m lightgbm_tpu obs-report --traces "

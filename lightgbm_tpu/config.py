@@ -214,6 +214,13 @@ _DEFAULTS: Dict[str, Any] = {
     "serve_max_inflight": 0,    # fleet-wide in-flight cap (0 = no cap)
     "serve_canary_model": "",   # optional second model file (A/B routing)
     "serve_canary_weight": 0.0,  # canary traffic share in [0, 1)
+    # serving fault tolerance (serve/health.py; docs/FAULT_TOLERANCE.md)
+    "serve_retry_limit": 2,     # hedged retries per request (0 = none)
+    "serve_error_threshold": 3,  # consecutive errors -> replica suspect
+    "serve_watchdog_ms": 250.0,  # health watchdog interval (0 = off)
+    "serve_stall_ms": 5000.0,   # device-batch stall age -> replica wedged
+    "serve_latency_outlier": 8.0,  # EWMA multiple of fleet median -> suspect
+    "serve_state_file": "",     # last-good model state JSON (crash restore)
     # observability (lightgbm_tpu/obs/; docs/OBSERVABILITY.md)
     "events_file": "",         # per-iteration JSONL event stream path
     "trace_dir": "",           # device trace dir (LIGHTGBM_TPU_TRACE_DIR wins)
@@ -393,6 +400,20 @@ class Config:
         if v["serve_canary_weight"] > 0 and not v["serve_canary_model"]:
             raise ValueError("serve_canary_weight > 0 needs a "
                              "serve_canary_model file to route to")
+        if v["serve_retry_limit"] < 0:
+            raise ValueError("serve_retry_limit must be >= 0 "
+                             "(0 disables hedged retries)")
+        if v["serve_error_threshold"] < 1:
+            raise ValueError("serve_error_threshold must be >= 1")
+        if v["serve_watchdog_ms"] < 0:
+            raise ValueError("serve_watchdog_ms must be >= 0 "
+                             "(0 disables the health watchdog)")
+        if v["serve_stall_ms"] < 0:
+            raise ValueError("serve_stall_ms must be >= 0 "
+                             "(0 disables the wedge detector)")
+        if v["serve_latency_outlier"] <= 1.0:
+            raise ValueError("serve_latency_outlier must be > 1 — it "
+                             "multiplies the fleet-median service time")
         # num_machines here means mesh devices; 1 device => normalize back to
         # serial like the reference (config.cpp:161-172).
         if v["num_machines"] <= 1:

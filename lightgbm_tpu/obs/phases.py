@@ -39,6 +39,10 @@ HOST_PHASES = frozenset({
     "Serve::dispatch",    # routing decision: canary split + least-loaded
     "Serve::reload",      # hot swap: build + warm a new generation
     "Serve::drain",       # old generation: wait out in-flight, close
+    # serving fault tolerance (serve/health.py: replica health machine)
+    "Serve::hedge",       # one retried dispatch onto a different replica
+    "Serve::eject",       # watchdog removing a bad replica from dispatch
+    "Serve::probe",       # synthetic probe of an ejected replica
 })
 
 DEVICE_PHASES = frozenset({

@@ -11,19 +11,29 @@
               queue + per-model metric labels for the fleet).
 ``fleet``   — :class:`Fleet` of per-device replicas: least-loaded
               dispatch, admission control (shed with retry-after),
-              canary routing, and :class:`ModelManager` zero-downtime
-              hot reload.
+              canary routing, hedged retries + request deadlines, and
+              :class:`ModelManager` zero-downtime (and crash-safe) hot
+              reload.
+``health``  — replica health state machine
+              (healthy/suspect/ejected/probation), the ejection
+              watchdog and synthetic probes
+              (docs/FAULT_TOLERANCE.md §Serving).
 ``server``  — stdlib HTTP front end (``python -m lightgbm_tpu serve``).
 """
 
-from .batcher import (BucketLadder, MicroBatcher, QueueFull,  # noqa: F401
+from .batcher import (BatcherClosed, BucketLadder,  # noqa: F401
+                      DeadlineExpired, MicroBatcher, QueueFull,
                       default_ladder)
 from .fleet import (Fleet, FleetResult, ModelManager,  # noqa: F401
                     Overloaded, Replica, ReplicaSet, fleet_devices)
 from .forest import CompiledForest  # noqa: F401
+from .health import (NoHealthyReplicas, ReplicaEjected,  # noqa: F401
+                     Watchdog)
 from .server import PredictServer, serve_from_config  # noqa: F401
 
 __all__ = ["CompiledForest", "BucketLadder", "MicroBatcher", "QueueFull",
+           "BatcherClosed", "DeadlineExpired",
            "default_ladder", "Fleet", "FleetResult", "ModelManager",
            "Overloaded", "Replica", "ReplicaSet", "fleet_devices",
+           "NoHealthyReplicas", "ReplicaEjected", "Watchdog",
            "PredictServer", "serve_from_config"]

@@ -46,6 +46,21 @@ class QueueFull(RuntimeError):
     overload into unbounded p99 instead."""
 
 
+class BatcherClosed(RuntimeError):
+    """``submit()`` against a closed (or aborted) batcher, or a request
+    failed by shutdown/ejection.  A RuntimeError subtype so the HTTP
+    layer's existing shutting-down 503 path keeps catching it, and a
+    distinct type so the fleet can hedge it onto a surviving replica."""
+
+
+class DeadlineExpired(RuntimeError):
+    """The request's ``deadline_ms`` passed before (or while) it could
+    be served.  Shed WITHOUT consuming device time wherever possible:
+    at fleet dispatch, at batcher submit, and in the worker's batch
+    assembly (an expired request is never coalesced into a device
+    batch).  The HTTP layer renders it as 504."""
+
+
 def default_ladder(lo: int = 16, hi: int = 65536) -> List[int]:
     """Power-of-two bucket sizes from ``lo`` to ``hi`` inclusive."""
     lo = max(int(lo), 1)
@@ -127,14 +142,19 @@ def pad_rows(X: np.ndarray, bucket: int):
 
 
 class _Pending:
-    __slots__ = ("rows", "done", "result", "error", "t0", "tspan")
+    __slots__ = ("rows", "done", "result", "error", "t0", "deadline",
+                 "tspan")
 
-    def __init__(self, rows: np.ndarray):
+    def __init__(self, rows: np.ndarray,
+                 deadline: Optional[float] = None):
         self.rows = rows
         self.done = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
         self.t0 = time.perf_counter()
+        # absolute time.monotonic() deadline (None = no deadline); the
+        # worker sheds expired entries BEFORE coalescing them
+        self.deadline = deadline
         # causal trace: the queue-wait span (enqueue -> batch pickup),
         # child of the submitting context's request span; None when the
         # tracer is disarmed.  Ended by the WORKER thread at pickup.
@@ -169,6 +189,15 @@ class MicroBatcher:
     historical behavior): a submit against a full queue raises
     :class:`QueueFull` instead of parking — admission control for the
     fleet dispatcher.
+
+    Requests may carry an absolute ``deadline`` (``time.monotonic()``
+    instant): expired work is shed with :class:`DeadlineExpired` at
+    submit, in the queue, and during batch assembly — a device batch is
+    never coalesced around an already-expired member, and
+    ``serve_deadline_expired_total`` counts every shed.  ``abort()``
+    (replica ejection) and the post-join fallback in ``close()``
+    guarantee every accepted request's future completes or fails — a
+    wedged ``predict_fn`` can strand its worker thread, never a caller.
     """
 
     def __init__(self, predict_fn: Callable[[np.ndarray], np.ndarray],
@@ -186,7 +215,16 @@ class MicroBatcher:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: List[_Pending] = []
+        # the batch currently on the device: tracked so close()/abort()
+        # can FAIL its futures if the worker is wedged inside predict_fn
+        # (a future must complete or fail, never hang), and timestamped
+        # so the health watchdog's wedge detector measures how long the
+        # worker has been stuck inside ONE batch (queue wait under load
+        # must not look like a wedge)
+        self._active: List[_Pending] = []
+        self._active_since: Optional[float] = None
         self._closed = False
+        self._aborted = False
         self._lat_seq = 0
         self._worker = threading.Thread(target=self._run,
                                         name="lgbt-serve-batcher",
@@ -215,18 +253,36 @@ class MicroBatcher:
         with self._cond:
             return len(self._queue)
 
+    def stalled_for_s(self) -> Optional[float]:
+        """Seconds the worker has been inside the CURRENT device batch
+        (None when idle/between batches) — the wedge detector's signal
+        (serve/health.py): a wedged ``predict_fn`` never returns, so
+        only this age can indict it, and unlike request sojourn it does
+        NOT grow under plain queueing load."""
+        with self._cond:
+            since = self._active_since
+        return None if since is None else time.monotonic() - since
+
     # -- client side -----------------------------------------------------
-    def submit(self, rows: np.ndarray, timeout: Optional[float] = None):
+    def submit(self, rows: np.ndarray, timeout: Optional[float] = None,
+               deadline: Optional[float] = None):
         """Block until the batch containing ``rows`` is served; returns
         whatever ``predict_fn`` produced for this request's row span.
         Raises :class:`QueueFull` (shedding, no wait) when a bounded
-        queue is at capacity."""
+        queue is at capacity, :class:`BatcherClosed` after ``close()``/
+        ``abort()``, and :class:`DeadlineExpired` when ``deadline`` (an
+        absolute ``time.monotonic()`` instant) passes before the result
+        is ready — expired work is shed before it consumes device
+        time."""
         rows = np.ascontiguousarray(rows)
-        req = _Pending(rows)
+        if deadline is not None and time.monotonic() >= deadline:
+            self._inc("serve_deadline_expired_total")
+            raise DeadlineExpired("deadline expired before enqueue")
+        req = _Pending(rows, deadline=deadline)
         with self._cond:
             if self._closed:
                 obs.trace_end(req.tspan, args={"closed": True})
-                raise RuntimeError("MicroBatcher is closed")
+                raise BatcherClosed("MicroBatcher is closed")
             if self.max_queue and len(self._queue) >= self.max_queue:
                 obs.trace_end(req.tspan, args={"shed": True})
                 raise QueueFull(
@@ -235,45 +291,129 @@ class MicroBatcher:
             self._cond.notify_all()
         self._inc("serve_requests")
         self._inc("serve_rows", int(rows.shape[0]))
-        if not req.done.wait(timeout):
+        wait_s = timeout
+        if deadline is not None:
+            left = deadline - time.monotonic()
+            wait_s = left if wait_s is None else min(wait_s, left)
+        if not req.done.wait(wait_s):
             # shed the request: a timed-out entry left in the queue
             # would still be computed AND hold max_batch capacity ahead
             # of live requests, compounding the overload it signals
             with self._cond:
-                shed = req in self._queue
+                settled = req.done.is_set()   # worker won the race
+                shed = not settled and req in self._queue
                 if shed:
                     self._queue.remove(req)
-            if shed:
-                # still queued -> the worker never picked it up and will
-                # never end its queue span; a picked-up-but-slow request
-                # had its span closed at batch start
-                obs.trace_end(req.tspan, args={"shed": True})
-            self._inc("serve_timeouts_shed")
-            raise TimeoutError("predict request timed out")
+            if not settled:
+                expired = (deadline is not None
+                           and time.monotonic() >= deadline)
+                if shed:
+                    # still queued -> the worker never picked it up and
+                    # will never end its queue span; a picked-up-but-slow
+                    # request had its span closed at batch start
+                    obs.trace_end(
+                        req.tspan,
+                        args={"expired" if expired else "shed": True})
+                if expired:
+                    self._inc("serve_deadline_expired_total")
+                    raise DeadlineExpired("deadline expired in queue")
+                self._inc("serve_timeouts_shed")
+                raise TimeoutError("predict request timed out")
         if req.error is not None:
             raise req.error
         self._note_latency((time.perf_counter() - req.t0) * 1000.0)
         return req.result
 
-    def close(self, drain: bool = True) -> None:
-        """Stop the worker; with ``drain`` (default) queued requests are
-        served first, otherwise they fail with RuntimeError."""
+    def _fail_pending_locked(self) -> List[Tuple[_Pending, bool]]:
+        """Detach every queued + in-flight request (caller holds the
+        cond); returns ``(request, still_queued)`` pairs for completion
+        outside the lock — only still-queued requests own their queue
+        span (the worker already ended a picked-up request's at batch
+        start)."""
+        doomed = [(r, True) for r in self._queue if not r.done.is_set()]
+        doomed += [(r, False) for r in self._active
+                   if not r.done.is_set()]
+        self._queue.clear()
+        return doomed
+
+    @staticmethod
+    def _complete_failed(doomed: Sequence[Tuple[_Pending, bool]],
+                         error: BaseException) -> None:
+        for req, still_queued in doomed:
+            if still_queued:
+                obs.trace_end(req.tspan, args={"failed": True})
+            req.error = error
+            req.done.set()
+
+    def abort(self, error: Optional[BaseException] = None) -> None:
+        """Hard stop: fail every queued AND in-flight request with
+        ``error`` immediately, without waiting for the worker (which may
+        be wedged inside ``predict_fn`` — replica ejection's whole
+        premise).  The worker thread is left to die on its own when the
+        wedge releases; a re-admitted replica gets a FRESH batcher."""
+        error = error or BatcherClosed("MicroBatcher aborted")
         with self._cond:
             self._closed = True
-            if not drain:
-                for req in self._queue:
-                    req.error = RuntimeError("MicroBatcher closed")
-                    req.done.set()
-                self._queue.clear()
+            self._aborted = True
+            doomed = self._fail_pending_locked()
             self._cond.notify_all()
-        self._worker.join(timeout=30.0)
+        self._complete_failed(doomed, error)
+
+    def close(self, drain: bool = True,
+              join_timeout_s: float = 30.0) -> None:
+        """Stop the worker; with ``drain`` (default) queued requests are
+        served first, otherwise they fail with :class:`BatcherClosed`.
+        Never leaves a future hanging: if the worker cannot finish
+        within ``join_timeout_s`` (a wedged ``predict_fn``), the
+        remaining queued/in-flight requests are failed instead."""
+        with self._cond:
+            already_aborted = self._aborted
+            self._closed = True
+            if not drain:
+                doomed = [(r, True) for r in self._queue
+                          if not r.done.is_set()]
+                self._queue.clear()
+                self._complete_failed(doomed,
+                                      BatcherClosed("MicroBatcher closed"))
+            self._cond.notify_all()
+        if already_aborted:
+            return                     # abort() already failed everything
+        self._worker.join(timeout=join_timeout_s)
+        if self._worker.is_alive():    # wedged predict_fn: fail, don't hang
+            with self._cond:
+                self._aborted = True
+                doomed = self._fail_pending_locked()
+            self._complete_failed(
+                doomed, BatcherClosed("MicroBatcher closed with a stalled "
+                                      "worker"))
 
     # -- worker side -----------------------------------------------------
+    def _shed_expired_locked(self) -> None:
+        """Fail queued requests whose deadline already passed (caller
+        holds the cond; ``done.set()`` under the lock is fine — waiters
+        wake after release).  A batch is therefore never coalesced
+        around an expired member — expired work is shed before it
+        consumes device time."""
+        now = time.monotonic()
+        expired = [r for r in self._queue
+                   if r.deadline is not None and now >= r.deadline]
+        if not expired:
+            return
+        self._queue[:] = [r for r in self._queue if r not in expired]
+        for req in expired:
+            obs.trace_end(req.tspan, args={"expired": True})
+            req.error = DeadlineExpired("deadline expired in queue")
+            req.done.set()
+        self._inc("serve_deadline_expired_total", len(expired))
+
     def _take_batch(self) -> Optional[List[_Pending]]:
         """Wait for work, then gather until max_batch rows or the oldest
         request's deadline passes.  Returns None on shutdown."""
         with self._cond:
-            while not self._queue:
+            while True:
+                self._shed_expired_locked()
+                if self._queue:
+                    break
                 if self._closed:
                     return None
                 self._cond.wait(timeout=0.1)
@@ -284,6 +424,7 @@ class MicroBatcher:
                 if rows >= self.max_batch or left <= 0:
                     break
                 self._cond.wait(timeout=left)
+            self._shed_expired_locked()
             batch: List[_Pending] = []
             total = 0
             while self._queue:
@@ -292,6 +433,8 @@ class MicroBatcher:
                     break
                 batch.append(self._queue.pop(0))
                 total += nxt
+            self._active = batch
+            self._active_since = time.monotonic() if batch else None
             return batch
 
     def _run(self) -> None:
@@ -330,6 +473,10 @@ class MicroBatcher:
                 for req in batch:
                     req.error = exc
                     req.done.set()
+            finally:
+                with self._cond:
+                    self._active = []
+                    self._active_since = None
 
     _GAUGE_EVERY = 32
 

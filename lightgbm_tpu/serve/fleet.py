@@ -42,15 +42,32 @@ and dies with its process.  Serving heavy traffic needs the layer above
   (``obs.labeled_name``), so the canary's latency histogram and shed
   counters are scrapeable side by side with the primary's.
 
+- **fault tolerance** (PR 9, serve/health.py, docs/FAULT_TOLERANCE.md
+  §Serving): every replica carries a health state
+  (healthy/suspect/ejected/probation) driven by consecutive errors, a
+  wedge (stalled in-flight) detector and an EWMA latency-outlier rule; a
+  watchdog ejects bad replicas (their queued work fails over to the
+  survivors), probes them with synthetic requests, and re-admits them on
+  probation.  Requests may carry a **deadline** (shed with 504 before
+  consuming device time once expired) and failed dispatches are
+  **hedged** onto a different replica up to ``serve_retry_limit`` times.
+  At zero dispatchable replicas ``submit`` raises
+  :class:`~.health.NoHealthyReplicas` (503) instead of hanging.
+
 Spans: ``Serve::dispatch`` (the routing decision, with
 model/generation/replica recorded into the request's causal trace),
-``Serve::reload`` (build + warm + swap) and ``Serve::drain`` (waiting
-out the old generation) — all in the ``obs/phases.py`` taxonomy and
+``Serve::hedge`` (one retried dispatch attempt), ``Serve::reload``
+(build + warm + swap), ``Serve::drain`` (waiting out the old
+generation), and — from the watchdog — ``Serve::eject`` /
+``Serve::probe`` — all in the ``obs/phases.py`` taxonomy and
 lint-enforced like every other span site.
 """
 
 from __future__ import annotations
 
+import contextlib
+import json
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -60,7 +77,10 @@ import numpy as np
 from .. import obs
 from ..utils import log
 from ..utils.log import LightGBMError
-from .batcher import MicroBatcher, QueueFull
+from . import health as health_mod
+from .batcher import DeadlineExpired, MicroBatcher, QueueFull
+from .health import (EJECTED, HEALTHY, PROBATION, NoHealthyReplicas,
+                     Watchdog)
 
 # EWMA smoothing for per-replica service time: ~the last 10 requests
 # dominate, old incidents decay instead of haunting the dispatch forever
@@ -80,6 +100,18 @@ class Overloaded(RuntimeError):
     def __init__(self, msg: str, retry_after_s: float = 1.0):
         super().__init__(msg)
         self.retry_after_s = float(retry_after_s)
+
+
+class _ReplicaFault(Exception):
+    """Internal: one dispatch attempt failed for a reason attributable
+    to the chosen replica (predict raised, ejected mid-request, batcher
+    closed).  Carries the original error for the hedging loop in
+    :meth:`Fleet.submit`; never escapes it."""
+
+    def __init__(self, replica_id: int, error: BaseException):
+        super().__init__(f"replica {replica_id}: {error!r}")
+        self.replica_id = int(replica_id)
+        self.error = error
 
 
 class FleetResult:
@@ -114,14 +146,39 @@ class Replica:
         self.model = str(model)
         self.generation = int(generation)
         self.device = getattr(forest, "device", None)
-        self.batcher = MicroBatcher(forest.batched_fn(),
-                                    max_batch=max_batch,
-                                    max_delay_s=max_delay_s,
-                                    max_queue=max_queue,
-                                    metric_labels={"model": self.model})
+        # batcher construction knobs, kept so a re-admitted replica can
+        # build a FRESH batcher (the ejected one's worker may be wedged)
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_s)
+        self.max_queue = int(max_queue)
+        self.batcher = self.make_batcher()
         self.inflight = 0
         self.requests = 0
         self.ewma_service_s = 0.0
+        # health state machine (serve/health.py; transitions under the
+        # owning fleet's lock)
+        self.health = HEALTHY
+        self.consecutive_errors = 0
+        self.errors = 0
+        self.ejections = 0
+        self.probation_left = 0
+        self.probation_failed = False
+        self.outlier_ticks = 0
+        self.probe: Optional[Dict[str, Any]] = None
+        self.probe_failures = 0
+        self.next_probe_t = 0.0
+
+    def make_batcher(self) -> MicroBatcher:
+        return MicroBatcher(self.forest.batched_fn(),
+                            max_batch=self.max_batch,
+                            max_delay_s=self.max_delay_s,
+                            max_queue=self.max_queue,
+                            metric_labels={"model": self.model})
+
+    def eligible(self) -> bool:
+        """Visible to dispatch (everything but ejected — suspect and
+        probation replicas keep serving while the watchdog deliberates)."""
+        return self.health != EJECTED
 
     def note_done(self, seconds: float) -> None:
         """Fold one completed request's service time into the EWMA
@@ -149,6 +206,10 @@ class Replica:
             "inflight": self.inflight,
             "requests": self.requests,
             "ewma_service_ms": round(self.ewma_service_s * 1000.0, 3),
+            "health": self.health,
+            "consecutive_errors": self.consecutive_errors,
+            "errors": self.errors,
+            "ejections": self.ejections,
         }
 
 
@@ -179,16 +240,26 @@ class ReplicaSet:
         device reuses ``forest`` as-is (default placement — the
         single-replica compatibility path keeps the caller's warmed
         jits); a real device gets an explicit ``to_device`` copy, warmed
-        THERE so its compiles are done before the set takes traffic."""
+        THERE so its compiles are done before the set takes traffic.
+
+        Crash-safe: a failure mid-build (warmup OOM, a bad device)
+        closes the batchers of every replica already built before
+        re-raising, so an aborted hot reload leaks no worker threads and
+        the serving generation is left exactly as it was."""
         replicas = []
-        for i, dev in enumerate(devices):
-            f = forest if dev is None else forest.to_device(dev)
-            if warm:
-                f.warmup(max_bucket=max_batch)
-            replicas.append(Replica(f, i, model, generation,
-                                    max_batch=max_batch,
-                                    max_delay_s=max_delay_s,
-                                    max_queue=max_queue))
+        try:
+            for i, dev in enumerate(devices):
+                f = forest if dev is None else forest.to_device(dev)
+                if warm:
+                    f.warmup(max_bucket=max_batch)
+                replicas.append(Replica(f, i, model, generation,
+                                        max_batch=max_batch,
+                                        max_delay_s=max_delay_s,
+                                        max_queue=max_queue))
+        except BaseException:
+            for rep in replicas:
+                rep.batcher.close(drain=False)
+            raise
         return cls(replicas, model, generation, model_path=model_path)
 
     @property
@@ -227,7 +298,10 @@ class Fleet:
                  canary_weight: float = 0.0, max_inflight: int = 0,
                  devices: Optional[Sequence] = None,
                  max_batch: int = 8192, max_delay_s: float = 0.005,
-                 max_queue: int = 0):
+                 max_queue: int = 0, retry_limit: int = 2,
+                 error_threshold: int = 3,
+                 watchdog_interval_s: float = 0.0,
+                 stall_s: float = 5.0, latency_outlier: float = 8.0):
         self._cond = threading.Condition()
         self._primary = primary
         self._canary = canary
@@ -246,6 +320,10 @@ class Fleet:
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_s)
         self.max_queue = int(max_queue)
+        # fault tolerance (serve/health.py, docs/FAULT_TOLERANCE.md):
+        # hedged-retry budget per request + the health policy knobs
+        self.retry_limit = max(int(retry_limit), 0)
+        self.error_threshold = max(int(error_threshold), 1)
         self._inflight = 0
         self._canary_acc = 0.0
         self._gen_seq = max(primary.generation,
@@ -253,6 +331,13 @@ class Fleet:
         self._closed = False
         obs.set_gauge("serve_generation", primary.generation)
         obs.set_gauge("serve_replicas", len(primary.replicas))
+        with self._cond:
+            self._update_health_gauge_locked()
+        self.watchdog: Optional[Watchdog] = None
+        if watchdog_interval_s > 0:
+            self.watchdog = Watchdog(self, interval_s=watchdog_interval_s,
+                                     stall_s=stall_s,
+                                     latency_outlier=latency_outlier)
 
     # -- construction ----------------------------------------------------
     @classmethod
@@ -261,7 +346,11 @@ class Fleet:
               canary_forest=None, canary_weight: float = 0.0,
               max_batch: int = 8192, max_delay_s: float = 0.005,
               max_queue: int = 0, max_inflight: int = 0,
-              warm: bool = True) -> "Fleet":
+              warm: bool = True, retry_limit: int = 2,
+              error_threshold: int = 3,
+              watchdog_interval_s: float = 0.0,
+              stall_s: float = 5.0,
+              latency_outlier: float = 8.0) -> "Fleet":
         """Spread ``forest`` over ``devices`` (default: the local
         devices, capped by ``replicas``) and front it with a dispatcher;
         ``canary_forest`` adds a second model at ``canary_weight``
@@ -281,7 +370,10 @@ class Fleet:
         return cls(primary, canary, canary_weight=canary_weight,
                    max_inflight=max_inflight, devices=devices,
                    max_batch=max_batch, max_delay_s=max_delay_s,
-                   max_queue=max_queue)
+                   max_queue=max_queue, retry_limit=retry_limit,
+                   error_threshold=error_threshold,
+                   watchdog_interval_s=watchdog_interval_s,
+                   stall_s=stall_s, latency_outlier=latency_outlier)
 
     @classmethod
     def from_forest(cls, forest, max_batch: int = 8192,
@@ -308,15 +400,51 @@ class Fleet:
         with self._cond:
             return self._primary.generation
 
+    def _live_sets(self) -> List[ReplicaSet]:
+        """The replica sets currently taking traffic (caller holds the
+        fleet lock) — what the watchdog evaluates and stats() reports."""
+        return [s for s in (self._primary, self._canary) if s is not None]
+
+    def _update_health_gauge_locked(self) -> None:
+        obs.set_gauge("serve_healthy_replicas",
+                      health_mod.healthy_count(self._live_sets()))
+
+    def warm_all(self, should_abort: Optional[Callable[[], bool]] = None
+                 ) -> bool:
+        """Warm every live replica's forest on its own device (used by
+        the HTTP server's background warm — readiness flips only after
+        this returns True).  ``should_abort`` is polled between bucket
+        compiles so a shutdown mid-warm stops after the CURRENT compile
+        instead of leaving an XLA compile racing interpreter teardown
+        (that race aborts the process with ``terminate called without
+        an active exception``).  Returns False when aborted."""
+        with self._cond:
+            reps = [rep for s in self._live_sets() for rep in s.replicas]
+        for rep in reps:
+            ladder = getattr(rep.forest, "ladder", None)
+            if ladder is None:
+                if should_abort is not None and should_abort():
+                    return False
+                rep.forest.warmup(max_bucket=self.max_batch)
+                continue
+            sizes = [s for s in ladder.sizes if s <= self.max_batch] \
+                or list(ladder.sizes)[:1]
+            for s in sizes:
+                if should_abort is not None and should_abort():
+                    return False
+                rep.forest.warmup(buckets=[s])
+        return True
+
     def stats(self) -> Dict[str, Any]:
         with self._cond:
-            sets = [s for s in (self._primary, self._canary)
-                    if s is not None]
+            sets = self._live_sets()
             return {
                 "generation": self._primary.generation,
                 "inflight": self._inflight,
                 "max_inflight": self.max_inflight,
                 "canary_weight": self.canary_weight,
+                "retry_limit": self.retry_limit,
+                "healthy_replicas": health_mod.healthy_count(sets),
                 "models": {
                     s.model: {"generation": s.generation,
                               "model_path": s.model_path,
@@ -352,21 +480,141 @@ class Fleet:
         obs.inc(obs.labeled_name("serve_shed_total", model=model))
         return Overloaded(reason, self._retry_after_s())
 
-    def submit(self, rows: np.ndarray,
-               timeout: Optional[float] = None) -> FleetResult:
-        """Route one request: canary split, least-loaded replica pick,
-        admission check — then block in that replica's batcher.  Raises
-        :class:`Overloaded` on shed (never queues past the bounds)."""
+    def _note_error_locked(self, rep: Replica) -> None:
+        """One replica-attributable request failure (fleet lock held):
+        enough consecutive errors — or ANY error on probation — marks
+        the replica suspect; the watchdog does the ejecting."""
+        rep.consecutive_errors += 1
+        rep.errors += 1
+        if rep.health == EJECTED:
+            return
+        if rep.health == PROBATION:
+            # one strike on probation: the sticky flag survives the
+            # SUSPECT transition so the watchdog ejects it even if a
+            # later success resets consecutive_errors
+            rep.probation_failed = True
+            rep.health = health_mod.SUSPECT
+        elif rep.consecutive_errors >= self.error_threshold:
+            rep.health = health_mod.SUSPECT
+
+    def _note_ok_locked(self, rep: Replica, dt: float) -> None:
+        rep.note_done(dt)
+        rep.consecutive_errors = 0
+        if rep.health == PROBATION:
+            rep.probation_left -= 1
+            if rep.probation_left <= 0:
+                rep.health = HEALTHY
+                self._update_health_gauge_locked()
+
+    def submit(self, rows: np.ndarray, timeout: Optional[float] = None,
+               deadline_s: Optional[float] = None) -> FleetResult:
+        """Route one request: canary split, least-loaded replica pick
+        among NON-EJECTED replicas, admission check — then block in that
+        replica's batcher.  Raises :class:`Overloaded` on shed (never
+        queues past the bounds), :class:`DeadlineExpired` when
+        ``deadline_s`` (absolute ``time.monotonic()``) has passed —
+        checked BEFORE any device time is spent — and
+        :class:`~.health.NoHealthyReplicas` when the routed model has
+        zero dispatchable replicas (503, never a hang).
+
+        A replica-attributable failure (predict raised, replica ejected
+        mid-request, batcher closed under it) is HEDGED: retried on a
+        different replica up to ``retry_limit`` times, each retry under
+        a ``Serve::hedge`` span and counted in ``serve_retries_total``."""
+        tried: set = set()
+        rs_holder: List[Optional[ReplicaSet]] = [None]
+        attempt = 0
+        last_fault: Optional[_ReplicaFault] = None
+        while True:
+            # no explicit expiry check here: the chosen replica's
+            # batcher pre-checks the deadline before enqueue (and counts
+            # the shed ONCE, base + model= labeled series), so an
+            # expired request — fresh or mid-hedge — still never
+            # reaches the device
+            hedge = (obs.trace_span(
+                "Serve::hedge",
+                args={"attempt": attempt,
+                      "failed_replica": last_fault.replica_id})
+                if attempt else contextlib.nullcontext())
+            try:
+                with hedge:
+                    return self._submit_once(rows, timeout, deadline_s,
+                                             tried, rs_holder)
+            except _ReplicaFault as fault:
+                last_fault = fault
+                attempt += 1
+                rs = rs_holder[0]
+                with self._cond:
+                    has_fresh = rs is not None and any(
+                        r.eligible() and r.replica_id not in tried
+                        for r in rs.replicas)
+                if attempt > self.retry_limit or not has_fresh:
+                    # no budget left, or no replica this request hasn't
+                    # already failed on — re-running the identical
+                    # predict on a known-bad replica only multiplies
+                    # error latency and inflates its error count
+                    raise fault.error
+                obs.inc("serve_retries_total")
+                if rs is not None:
+                    obs.inc(obs.labeled_name("serve_retries_total",
+                                             model=rs.model))
+                log.debug("serve: hedging request off replica %d "
+                          "(attempt %d/%d): %r", fault.replica_id,
+                          attempt, self.retry_limit, fault.error)
+
+    def _submit_once(self, rows: np.ndarray, timeout: Optional[float],
+                     deadline_s: Optional[float], tried: set,
+                     rs_holder: List[Optional[ReplicaSet]]) -> FleetResult:
+        """One dispatch attempt.  Replica-attributable failures are
+        wrapped in :class:`_ReplicaFault` for the hedging loop; shed
+        conditions (Overloaded / QueueFull / deadline / client timeout)
+        propagate unwrapped — retrying those on another replica would
+        amplify the very overload they signal."""
         with obs.trace_span("Serve::dispatch") as d:
             with self._cond:
                 if self._closed:
                     raise RuntimeError("fleet is closed")
-                rs = self._route()
+                rs = rs_holder[0]
+                if rs is None:
+                    rs = self._route()
+                else:
+                    # hedges stay on the model the request was routed
+                    # to, but a concurrent reload may have swapped the
+                    # set: re-resolve by slot so the retry lands on the
+                    # LIVE generation
+                    live = (self._canary if rs.model == "canary"
+                            else self._primary)
+                    rs = live if live is not None else rs
+                rs_holder[0] = rs
                 if self.max_inflight and self._inflight >= self.max_inflight:
                     raise self._shed(
                         rs.model,
                         f"fleet at max in-flight ({self.max_inflight})")
-                rep = min(rs.replicas, key=Replica.load_score)
+                cands = [r for r in rs.replicas if r.eligible()]
+                if not cands and rs is self._canary:
+                    # the canary slice must not become a hard 503 share
+                    # while healthy PRIMARY capacity sits idle: canary
+                    # traffic is best-effort A/B, so it falls back (the
+                    # reverse never happens — primary traffic is not
+                    # silently routed to an unvetted canary)
+                    fallback = [r for r in self._primary.replicas
+                                if r.eligible()]
+                    if fallback:
+                        obs.inc("serve_canary_fallback_total")
+                        log.warn_once(
+                            "serve_canary_fallback",
+                            "serve: canary has 0 dispatchable replicas; "
+                            "its traffic share falls back to the primary "
+                            "until a probe re-admits one")
+                        rs = rs_holder[0] = self._primary
+                        cands = fallback
+                if not cands:
+                    obs.inc("serve_unavailable_total")
+                    raise NoHealthyReplicas(
+                        f"model {rs.model!r}: 0 of {len(rs.replicas)} "
+                        f"replicas dispatchable")
+                fresh = [r for r in cands if r.replica_id not in tried]
+                rep = min(fresh or cands, key=Replica.load_score)
                 rs.outstanding += 1
                 rep.inflight += 1
                 self._inflight += 1
@@ -375,12 +623,31 @@ class Fleet:
                               replica=rep.replica_id)
         t0 = time.perf_counter()
         served = False
+        failed = None
         try:
-            raw, out = rep.batcher.submit(rows, timeout=timeout)
+            raw, out = rep.batcher.submit(rows, timeout=timeout,
+                                          deadline=deadline_s)
             served = True
+            return FleetResult(raw, out, rs.model, rs.generation,
+                               rep.replica_id)
         except QueueFull as exc:
             raise self._shed(
                 rs.model, f"replica {rep.replica_id}: {exc}") from exc
+        except (DeadlineExpired, Overloaded):
+            raise
+        except TimeoutError:
+            # the client's patience ran out — NOT a replica indictment:
+            # under fleet-wide overload every replica times out, and
+            # counting those as errors would eject the whole (healthy)
+            # fleet one replica at a time.  Genuine stragglers are the
+            # latency-outlier and stall detectors' job.
+            raise
+        except Exception as exc:
+            # predict raised / replica ejected mid-request / batcher
+            # closed under us: hedge-able
+            failed = True
+            tried.add(rep.replica_id)
+            raise _ReplicaFault(rep.replica_id, exc) from exc
         finally:
             dt = time.perf_counter() - t0
             with self._cond:
@@ -391,10 +658,10 @@ class Fleet:
                     # sheds/timeouts return in ~0s; folding them into
                     # the EWMA would make an overloaded replica look
                     # fast and attract MORE traffic
-                    rep.note_done(dt)
+                    self._note_ok_locked(rep, dt)
+                elif failed:
+                    self._note_error_locked(rep)
                 self._cond.notify_all()
-        return FleetResult(raw, out, rs.model, rs.generation,
-                           rep.replica_id)
 
     # -- generations -----------------------------------------------------
     def promote(self, forest, target: str = "primary",
@@ -448,6 +715,7 @@ class Fleet:
                 obs.set_gauge("serve_generation", gen)
             else:
                 old, self._canary = self._canary, new_set
+            self._update_health_gauge_locked()
         log.info("serve: generation %d (%s) live on %d replica(s); "
                  "draining generation %s", gen, model,
                  len(new_set.replicas),
@@ -478,14 +746,15 @@ class Fleet:
         obs.inc("serve_generations_drained")
 
     def close(self, drain: bool = True) -> None:
-        """Stop dispatching and close every batcher (with ``drain``,
-        queued requests are served first)."""
+        """Stop dispatching, stop the health watchdog, and close every
+        batcher (with ``drain``, queued requests are served first)."""
         with self._cond:
             if self._closed:
                 return
             self._closed = True
-            sets = [s for s in (self._primary, self._canary)
-                    if s is not None]
+            sets = self._live_sets()
+        if self.watchdog is not None:
+            self.watchdog.close()
         for s in sets:
             s.close(drain=drain)
 
@@ -497,16 +766,33 @@ class ModelManager:
     the fleet's bucket ladder, and promotes it — all serialized under
     one lock so two concurrent ``POST /reload``s cannot interleave their
     swaps.  ``loader`` is injectable for tests (and for callers that
-    already hold a booster)."""
+    already hold a booster).
+
+    Crash-safe on BOTH axes (docs/FAULT_TOLERANCE.md §Serving):
+
+    - a reload that fails anywhere mid-flight — unreadable/corrupt model
+      file, a width mismatch against the other live model, warmup
+      raising on a replica device — leaves the serving generation, its
+      predictions, and the compile ledger exactly as they were (the swap
+      is the LAST step; ``ReplicaSet.build`` closes any half-built
+      replicas before the error propagates);
+    - with a ``state_file``, every successful swap atomically records
+      the model path that is now serving (tmp + ``os.replace``, the
+      snapshot.py protocol), and a restarted server re-serves that
+      LAST-GOOD model instead of the possibly-stale boot
+      ``input_model`` (``restore_path`` / ``serve_state_file``).
+    """
 
     def __init__(self, fleet: Fleet,
                  loader: Optional[Callable[[str], Any]] = None,
                  params: Optional[Dict[str, Any]] = None,
-                 buckets: Optional[Sequence[int]] = None):
+                 buckets: Optional[Sequence[int]] = None,
+                 state_file: Optional[str] = None):
         self.fleet = fleet
         self._loader = loader or self._load_model_file
         self._params = dict(params or {})
         self._buckets = list(buckets) if buckets else None
+        self.state_file = str(state_file) if state_file else None
         self._reload_lock = threading.Lock()
 
     def _load_model_file(self, path: str):
@@ -523,7 +809,9 @@ class ModelManager:
 
     def reload(self, model_path: str, target: str = "primary") -> int:
         """Hot-swap ``target`` to the model at ``model_path``; returns
-        the new generation id once the old generation has drained."""
+        the new generation id once the old generation has drained.  Any
+        failure before the atomic swap leaves the old generation
+        serving, untouched."""
         with self._reload_lock:
             with obs.span("Serve::reload"):
                 t0 = time.perf_counter()
@@ -533,4 +821,64 @@ class ModelManager:
                 log.info("serve: reload of %s -> generation %d took %.2fs",
                          model_path, new_set.generation,
                          time.perf_counter() - t0)
+            self.note_good(str(model_path), target=target,
+                           generation=new_set.generation)
             return new_set.generation
+
+    # -- last-good model state (crash restore) ---------------------------
+    def note_good(self, model_path: str, target: str = "primary",
+                  generation: int = 0) -> None:
+        """Record ``model_path`` as the last model that successfully
+        served ``target``.  Atomic (tmp + ``os.replace``) and
+        best-effort: a state write failure warns, it never fails the
+        reload that already succeeded."""
+        if not self.state_file:
+            return
+        try:
+            state = self.read_state(self.state_file)
+            state[str(target)] = {"model": str(model_path),
+                                  "generation": int(generation),
+                                  "t": round(time.time(), 3)}
+            directory = os.path.dirname(self.state_file)
+            if directory:
+                os.makedirs(directory, exist_ok=True)
+            tmp = self.state_file + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(state, fh)
+            os.replace(tmp, self.state_file)
+        except OSError as exc:
+            log.warn_once("serve_state_write",
+                          "serve state file %s not writable (%s); restart "
+                          "will boot from input_model", self.state_file, exc)
+
+    @staticmethod
+    def read_state(state_file: str) -> Dict[str, Any]:
+        """Parse a serve state file (missing/corrupt -> empty dict: a
+        damaged state file must degrade to the boot model, not kill the
+        server)."""
+        try:
+            with open(state_file) as fh:
+                state = json.load(fh)
+            return state if isinstance(state, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    @staticmethod
+    def restore_path(state_file: Optional[str],
+                     target: str = "primary") -> Optional[str]:
+        """The last-good model path for ``target`` if the state file
+        names one that still exists on disk (else None — boot from
+        ``input_model``)."""
+        if not state_file:
+            return None
+        entry = ModelManager.read_state(state_file).get(str(target))
+        if not isinstance(entry, dict):
+            return None          # hand-edited/foreign slot: degrade
+        path = entry.get("model")
+        if not isinstance(path, str) or not path:
+            return None
+        if os.path.exists(path):
+            return path
+        log.warning("serve: last-good model %s from %s no longer "
+                    "exists; booting from input_model", path, state_file)
+        return None

@@ -24,7 +24,11 @@ Protocol (JSON in/out; CSV/TSV accepted for rows):
   serving path, swaps in atomically, and the old generation drains
   (in-flight requests finish on the forest they started on).  Responds
   with the new generation id once the drain completes.
-- ``GET /healthz``: liveness + frozen-forest shape info + generation.
+- ``GET /healthz``: LIVENESS — process up + frozen-forest shape info +
+  generation (200 even while warming or draining).
+- ``GET /readyz``: READINESS — 503 before the background warmup
+  completes and once the shutdown drain starts; wire THIS to the load
+  balancer's rotation, ``/healthz`` to the restart policy.
 - ``GET /stats``: the FULL obs registry snapshot as JSON — every
   counter, every numeric gauge, per-histogram summaries
   (count/sum/p50/p99) — plus the fleet topology (per-replica queue
@@ -36,10 +40,14 @@ Protocol (JSON in/out; CSV/TSV accepted for rows):
 
 Overload: bounded per-replica queues + a fleet-wide in-flight cap shed
 excess load as ``429`` with a ``Retry-After`` computed from the
-observed p50 service time (``serve_shed_total`` counts them).  EVERY
-response — success, shed, bad input, timeout — echoes ``X-Request-Id``
-and closes its ``Serve::request`` trace span, so a client-held id is
-always findable in the causal trace export.
+observed p50 service time (``serve_shed_total`` counts them).  Fault
+tolerance (serve/health.py, docs/FAULT_TOLERANCE.md §Serving): requests
+may carry ``deadline_ms`` (expired work sheds with ``504`` before
+consuming device time), replica failures hedge onto survivors, and at
+zero healthy replicas ``/predict`` answers ``503`` — never hangs.
+EVERY response — success, shed, bad input, timeout, deadline — echoes
+``X-Request-Id`` and closes its ``Serve::request`` trace span, so a
+client-held id is always findable in the causal trace export.
 
 Shutdown is graceful: SIGINT/SIGTERM (or ``PredictServer.stop()``)
 stops accepting, drains every replica's batcher, then joins the HTTP
@@ -53,6 +61,7 @@ import json
 import math
 import signal
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Mapping, Optional
 
@@ -61,8 +70,10 @@ import numpy as np
 from .. import obs
 from ..utils import log
 from ..utils.log import LightGBMError
+from .batcher import DeadlineExpired
 from .fleet import Fleet, ModelManager, Overloaded
 from .forest import CompiledForest
+from .health import NoHealthyReplicas
 
 # monotonically increasing request ids: echoed in the X-Request-Id
 # response header and attached to each request's causal-trace root span,
@@ -72,15 +83,19 @@ _request_ids = itertools.count(1)
 
 
 def _parse_rows(body: bytes, content_type: str):
-    """Request body -> ``([n, F] f32 row matrix, raw_score)`` (JSON
+    """Request body -> ``([n, F] f32 row matrix, options dict)`` (JSON
     list-of-lists / one flat list for a single row, or CSV/TSV text
-    lines; ``raw_score`` only via the JSON envelope)."""
-    raw_score = False
+    lines).  Options (JSON envelope only): ``raw_score`` and
+    ``deadline_ms`` — a per-request latency budget; work the budget
+    cannot cover is shed with 504 before consuming device time."""
+    opts = {"raw_score": False, "deadline_ms": None}
     if "json" in (content_type or ""):
         payload = json.loads(body.decode("utf-8"))
         if isinstance(payload, dict):
             rows = payload.get("rows", [])
-            raw_score = bool(payload.get("raw_score", False))
+            opts["raw_score"] = bool(payload.get("raw_score", False))
+            if payload.get("deadline_ms") is not None:
+                opts["deadline_ms"] = float(payload["deadline_ms"])
         else:
             rows = payload
         arr = np.asarray(rows, dtype=np.float32)
@@ -92,7 +107,7 @@ def _parse_rows(body: bytes, content_type: str):
                           for ln in lines], dtype=np.float32)
     if arr.ndim == 1:
         arr = arr.reshape(1, -1)
-    return arr, raw_score
+    return arr, opts
 
 
 def _json_predictions(raw: np.ndarray, out: np.ndarray,
@@ -158,9 +173,20 @@ class _Handler(BaseHTTPRequestHandler):
         srv: "PredictServer" = self.server.predict_server
         req_id = next(_request_ids)
         if self.path == "/healthz":
+            # LIVENESS: the process is up and handling HTTP — 200 even
+            # while warming or draining (restarting a warming server
+            # only makes the warmup tax recurring)
             self._reply(200, {"status": "ok",
+                              "ready": srv.is_ready(),
                               "generation": srv.fleet.generation,
                               **srv.forest.info()}, req_id)
+        elif self.path == "/readyz":
+            # READINESS: take this instance out of rotation before
+            # warmup completes and during the shutdown drain
+            ready, why = srv.readiness()
+            self._reply(200 if ready else 503,
+                        {"status": why,
+                         "generation": srv.fleet.generation}, req_id)
         elif self.path == "/stats":
             # the WHOLE registry, not a hand-picked key list: new metric
             # names (histogram series included) surface here without this
@@ -201,7 +227,7 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
-                rows, raw_score = _parse_rows(
+                rows, opts = _parse_rows(
                     body, self.headers.get("Content-Type", ""))
                 # validate per request BEFORE coalescing: a malformed
                 # width must 400 here, not poison every request sharing
@@ -218,13 +244,27 @@ class _Handler(BaseHTTPRequestHandler):
                     rh.args["status"] = 400
                 self._reply(400, {"error": f"bad request: {exc}"}, req_id)
                 return
+            ready, why = srv.readiness()
+            if not ready:
+                # not in rotation: warming (background warmup still
+                # compiling — shed instead of paying hot-path compiles)
+                # or draining (shutdown requested)
+                if rh is not None:
+                    rh.args["status"] = 503
+                self._reply(503, {"error": f"server {why}"}, req_id,
+                            headers={"Retry-After": 1})
+                return
+            deadline_s = None
+            if opts["deadline_ms"] is not None:
+                deadline_s = time.monotonic() + opts["deadline_ms"] / 1000.0
             status = 500
             try:
-                res = srv.fleet.submit(rows, timeout=srv.request_timeout)
+                res = srv.fleet.submit(rows, timeout=srv.request_timeout,
+                                       deadline_s=deadline_s)
                 status = 200
                 self._reply(200, {
                     "predictions": _json_predictions(res.raw, res.out,
-                                                     raw_score),
+                                                     opts["raw_score"]),
                     "num_rows": int(rows.shape[0]),
                     "request_id": req_id,
                     "model": res.model,
@@ -240,15 +280,28 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(429, {"error": f"overloaded: {exc}",
                                   "retry_after_s": retry}, req_id,
                             headers={"Retry-After": retry})
+            except DeadlineExpired as exc:
+                # the request's own budget ran out: 504, shed before
+                # device time wherever possible (serve/batcher.py)
+                status = 504
+                self._reply(504, {"error": f"deadline expired: {exc}"},
+                            req_id)
+            except NoHealthyReplicas as exc:
+                # zero dispatchable replicas: fail fast, never hang —
+                # the watchdog's probes re-admit recovered replicas
+                status = 503
+                self._reply(503, {"error": f"no healthy replicas: {exc}"},
+                            req_id, headers={"Retry-After": 1})
             except TimeoutError:
                 status = 503
                 obs.inc("serve_timeouts")
                 self._reply(503, {"error": "prediction timed out"}, req_id)
-            except RuntimeError:
-                # fleet/batcher closed: mid graceful shutdown — retryable
+            except RuntimeError as exc:
+                # fleet/batcher closed (graceful shutdown) or retries
+                # exhausted against ejected replicas — retryable
                 status = 503
                 obs.inc("serve_shedding")
-                self._reply(503, {"error": "server shutting down"}, req_id)
+                self._reply(503, {"error": f"retry later: {exc}"}, req_id)
             except Exception as exc:
                 obs.inc("serve_errors")
                 self._reply(500, {"error": str(exc)}, req_id)
@@ -312,14 +365,17 @@ class PredictServer:
                  port: int = 8080, max_batch: int = 8192,
                  max_delay_ms: float = 5.0,
                  request_timeout: float = 60.0,
-                 params: Optional[dict] = None):
+                 params: Optional[dict] = None,
+                 state_file: Optional[str] = None,
+                 warm_in_background: bool = False):
         if isinstance(forest, Fleet):
             self.fleet = forest
         else:
             self.fleet = Fleet.from_forest(
                 forest, max_batch=max_batch,
                 max_delay_s=max_delay_ms / 1000.0)
-        self.manager = ModelManager(self.fleet, params=params)
+        self.manager = ModelManager(self.fleet, params=params,
+                                    state_file=state_file)
         self.request_timeout = float(request_timeout)
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.daemon_threads = True
@@ -328,6 +384,45 @@ class PredictServer:
         self._stop_requested = threading.Event()
         self._stop_lock = threading.Lock()
         self._stopped = False
+        # readiness (GET /readyz): liveness comes up with the listener,
+        # readiness only once the fleet is warm.  With
+        # ``warm_in_background`` start() kicks off fleet.warm_all() on a
+        # thread and readiness flips when it finishes — the orchestrator
+        # can health-check the process minutes before it takes traffic.
+        self._warm_in_background = bool(warm_in_background)
+        self._warm_thread: Optional[threading.Thread] = None
+        self._ready = threading.Event()
+        if not self._warm_in_background:
+            self._ready.set()       # caller handed us a warmed fleet
+
+    def is_ready(self) -> bool:
+        return self._ready.is_set() and not self._stop_requested.is_set()
+
+    def readiness(self):
+        """(ready, state) for ``GET /readyz``: ``warming`` before the
+        fleet warm completes, ``draining`` once shutdown has been
+        requested, ``ready`` otherwise."""
+        if self._stop_requested.is_set():
+            return False, "draining"
+        if not self._ready.is_set():
+            return False, "warming"
+        return True, "ready"
+
+    def _warm_fleet(self) -> None:
+        try:
+            done = self.fleet.warm_all(
+                should_abort=self._stop_requested.is_set)
+        except Exception as exc:
+            # stay NOT ready: the orchestrator's readiness gate keeps
+            # traffic away and its policy decides whether to restart
+            log.warning("serve: background warmup failed: %r — readiness "
+                        "stays false", exc)
+            return
+        if not done:
+            log.info("serve: background warmup aborted by shutdown")
+            return
+        self._ready.set()
+        log.info("serve: fleet warm, readiness up")
 
     @property
     def forest(self) -> CompiledForest:
@@ -345,12 +440,18 @@ class PredictServer:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         name="lgbt-serve-http", daemon=True)
         self._thread.start()
+        if self._warm_in_background and not self._ready.is_set():
+            self._warm_thread = threading.Thread(
+                target=self._warm_fleet, name="lgbt-serve-warmup",
+                daemon=True)
+            self._warm_thread.start()
         host, port = self.address
         st = self.fleet.stats()
         log.info("serving CompiledForest (%d trees, %d class) on "
-                 "http://%s:%d — %d replica(s), generation %d",
+                 "http://%s:%d — %d replica(s), generation %d%s",
                  self.forest.num_trees, self.forest.num_class, host, port,
-                 len(st["replicas"]), st["generation"])
+                 len(st["replicas"]), st["generation"],
+                 "" if self.is_ready() else " (warming in background)")
         return self
 
     def stop(self) -> None:
@@ -364,6 +465,12 @@ class PredictServer:
         self.httpd.shutdown()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
+        if self._warm_thread is not None and self._warm_thread.is_alive():
+            # wait out the warm thread's CURRENT bucket compile (it
+            # polls _stop_requested between buckets): exiting with an
+            # XLA compile in flight aborts the whole process at
+            # interpreter teardown
+            self._warm_thread.join(timeout=120.0)
         self.fleet.close(drain=True)
         self.httpd.server_close()
         # flush the causal trace AFTER the drain so the last batch's
@@ -432,14 +539,32 @@ def serve_from_config(config, params=None) -> PredictServer:
         booster = Booster(params=dict(params or {}), model_file=path)
         return CompiledForest.from_booster(booster, buckets=buckets)
 
-    forest = _freeze(config.input_model)
+    # crash restore: a state file records the last model that
+    # successfully served; a restarted server re-serves THAT, not the
+    # possibly-stale boot input_model (docs/FAULT_TOLERANCE.md §Serving)
+    state_file = str(getattr(config, "serve_state_file", "") or "") or None
+    model_path = str(config.input_model)
+    restored = ModelManager.restore_path(state_file)
+    if restored and restored != model_path:
+        log.info("serve: restoring last-good model %s (state file %s; "
+                 "input_model was %s)", restored, state_file, model_path)
+        model_path = restored
+    forest = _freeze(model_path)
     canary = None
-    canary_path = str(getattr(config, "serve_canary_model", "") or "")
+    # the state file restores the canary only when the CONFIG still has
+    # a canary slot — a stale entry from a since-removed canary must not
+    # resurrect one (and waste a warmed ReplicaSet on zero traffic)
+    cfg_canary = str(getattr(config, "serve_canary_model", "") or "")
+    canary_path = ""
+    if cfg_canary:
+        canary_path = ModelManager.restore_path(state_file, "canary") \
+            or cfg_canary
     if canary_path:
         canary = _freeze(canary_path)
     devices = fleet_devices(int(getattr(config, "serve_replicas", 0)))
-    log.info("serve: warming %d bucket(s) for %d trees on %d replica(s)%s"
-             "...", len(forest.ladder.sizes), forest.num_trees,
+    log.info("serve: %d bucket(s) for %d trees on %d replica(s)%s — "
+             "warming in background, readiness at /readyz",
+             len(forest.ladder.sizes), forest.num_trees,
              len(devices), " + canary" if canary is not None else "")
     fleet = Fleet.build(
         forest, devices=devices,
@@ -449,11 +574,28 @@ def serve_from_config(config, params=None) -> PredictServer:
         max_delay_s=float(config.serve_max_delay_ms) / 1000.0,
         max_queue=int(getattr(config, "serve_queue_depth", 0)),
         max_inflight=int(getattr(config, "serve_max_inflight", 0)),
-        warm=True)
-    return PredictServer(
+        retry_limit=int(getattr(config, "serve_retry_limit", 2)),
+        error_threshold=int(getattr(config, "serve_error_threshold", 3)),
+        watchdog_interval_s=float(
+            getattr(config, "serve_watchdog_ms", 250.0)) / 1000.0,
+        stall_s=float(getattr(config, "serve_stall_ms", 5000.0)) / 1000.0,
+        latency_outlier=float(getattr(config, "serve_latency_outlier",
+                                      8.0)),
+        warm=False)
+    server = PredictServer(
         fleet,
         host=str(getattr(config, "serve_host", "127.0.0.1") or "127.0.0.1"),
         port=int(config.serve_port),
         max_batch=max_batch,
         max_delay_ms=float(config.serve_max_delay_ms),
-        params=dict(params or {}))
+        params=dict(params or {}),
+        state_file=state_file,
+        warm_in_background=True)
+    # the boot model is the first last-good model: a crash before any
+    # reload restores to exactly what was serving
+    server.manager.note_good(model_path, generation=fleet.generation)
+    if canary is not None:
+        canary_gen = fleet.stats()["models"]["canary"]["generation"]
+        server.manager.note_good(canary_path, target="canary",
+                                 generation=canary_gen)
+    return server

@@ -13,7 +13,17 @@ fragments:
 - ``torn_snapshot_write``: a snapshot write crashes mid-file (exercises
   the atomic tmp+``os.replace`` protocol and checksum fallback);
 - ``truncate_file`` / ``flip_byte``: corrupt a file on disk after the
-  fact (bit rot / torn storage on an already-written snapshot).
+  fact (bit rot / torn storage on an already-written snapshot);
+- serving injectors (PR 9, the chaos suite in tests/test_serve_chaos.py,
+  marker ``chaos``): ``wedge_replica`` (a replica's device predict
+  blocks until release — the classic hung-device failure),
+  ``poison_predict`` (predict raises on one replica),
+  ``slow_replica`` (added service latency — the straggler),
+  ``fail_warmup`` (``CompiledForest.warmup`` raises — a hot reload
+  dying mid-warm).  Each patches the replica's FOREST as well as its
+  live batcher, so the health watchdog's synthetic probes see the same
+  fault the traffic does (and recovery probes succeed only once the
+  fault is lifted).
 
 None of these are test-only hacks around private invariants: they throw
 real exceptions through real call stacks, which is the point.
@@ -23,6 +33,8 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
+import time
 from typing import Iterator, Optional
 
 
@@ -139,6 +151,135 @@ def truncate_file(path: str, keep_bytes: Optional[int] = None) -> None:
     keep = size // 2 if keep_bytes is None else max(int(keep_bytes), 0)
     with open(path, "r+b") as fh:
         fh.truncate(keep)
+
+
+# ---------------------------------------------------------------------------
+# serving fault injectors (serve/fleet.py + serve/health.py)
+
+
+def _find_replica(fleet, replica_id: int, model: str = "primary"):
+    with fleet._cond:
+        rs = fleet._primary if model == "primary" else fleet._canary
+        if rs is None:
+            raise ValueError(f"fleet has no {model!r} replica set")
+        for rep in rs.replicas:
+            if rep.replica_id == int(replica_id):
+                return rep
+    raise ValueError(f"no replica {replica_id} in {model!r}")
+
+
+@contextlib.contextmanager
+def _patched_predict(fleet, replica_id: int, wrap,
+                     model: str = "primary") -> Iterator[dict]:
+    """Shared plumbing: wrap ``replica.forest.batched_fn`` with ``wrap``
+    (fault view for future batchers AND the watchdog's probes) and swap
+    the live batcher's ``predict_fn`` to the same faulty callable (fault
+    view for traffic already flowing).  Restores both on exit — but a
+    batcher REPLACED meanwhile (ejection -> re-admission builds a fresh
+    one from the forest) is left alone: it was built from the restored
+    forest or will be on the next probe."""
+    rep = _find_replica(fleet, replica_id, model)
+    stats = {"replica": rep, "calls": 0}
+    orig_batched_fn = rep.forest.batched_fn
+
+    def faulty_batched_fn():
+        inner = orig_batched_fn()
+
+        def fn(rows):
+            stats["calls"] += 1
+            return wrap(inner, rows)
+        return fn
+
+    rep.forest.batched_fn = faulty_batched_fn
+    patched_batcher = rep.batcher
+    orig_predict_fn = patched_batcher.predict_fn
+    patched_batcher.predict_fn = faulty_batched_fn()
+    try:
+        yield stats
+    finally:
+        del rep.forest.batched_fn          # instance attr -> class method
+        if rep.batcher is patched_batcher:
+            patched_batcher.predict_fn = orig_predict_fn
+
+
+@contextlib.contextmanager
+def wedge_replica(fleet, replica_id: int,
+                  model: str = "primary") -> Iterator[dict]:
+    """Wedge one replica: its device predict (traffic AND probes)
+    blocks until the context exits — the hung-device failure the health
+    watchdog's stall detector exists for.  On exit the wedge releases,
+    so the next probe succeeds and the replica can be re-admitted.
+    Yields a stats dict whose ``release`` event can lift the wedge
+    early."""
+    release = threading.Event()
+
+    def wedged(inner, rows):
+        release.wait()
+        return inner(rows)
+
+    with _patched_predict(fleet, replica_id, wedged, model) as stats:
+        stats["release"] = release
+        try:
+            yield stats
+        finally:
+            release.set()
+
+
+@contextlib.contextmanager
+def poison_predict(fleet, replica_id: int, model: str = "primary",
+                   error: Optional[BaseException] = None) -> Iterator[dict]:
+    """Every predict on one replica raises (a poisoned compile, a
+    device in a bad state).  Probes fail too, so the replica stays
+    ejected until the context exits."""
+    exc = error or InjectedCrash(
+        f"injected predict poison on replica {replica_id}")
+
+    def poisoned(inner, rows):
+        raise exc
+
+    with _patched_predict(fleet, replica_id, poisoned, model) as stats:
+        stats["error"] = exc
+        yield stats
+
+
+@contextlib.contextmanager
+def slow_replica(fleet, replica_id: int, delay_s: float,
+                 model: str = "primary") -> Iterator[dict]:
+    """One replica serves ``delay_s`` slower than it should — the
+    straggler the EWMA latency-outlier rule is for.  Results stay
+    correct; only time is poisoned."""
+    def slowed(inner, rows):
+        time.sleep(float(delay_s))
+        return inner(rows)
+
+    with _patched_predict(fleet, replica_id, slowed, model) as stats:
+        stats["delay_s"] = float(delay_s)
+        yield stats
+
+
+@contextlib.contextmanager
+def fail_warmup(times: int = 1) -> Iterator[dict]:
+    """The next ``times`` ``CompiledForest.warmup`` calls raise — a hot
+    reload crashing mid-warm on a replica device.  The reload contract
+    under test: the serving generation, its predictions, and the
+    compile ledger stay untouched (ModelManager.reload rolls back)."""
+    from ..serve.forest import CompiledForest
+
+    stats = {"failed": 0}
+    orig = CompiledForest.warmup
+
+    def failing_warmup(self, *args, **kwargs):
+        if stats["failed"] < int(times):
+            stats["failed"] += 1
+            raise InjectedCrash(
+                f"injected warmup failure ({stats['failed']}/{times})")
+        return orig(self, *args, **kwargs)
+
+    CompiledForest.warmup = failing_warmup
+    try:
+        yield stats
+    finally:
+        CompiledForest.warmup = orig
 
 
 def flip_byte(path: str, offset: int = -1) -> None:

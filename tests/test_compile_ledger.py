@@ -20,6 +20,42 @@ from lightgbm_tpu.obs import compile_ledger
 
 
 @pytest.fixture
+def fresh_train_programs(monkeypatch):
+    """Order-independence for the end-to-end training test: round 7
+    made ``train_step``/``pack_words`` PROCESS-WIDE shared programs
+    (models/gbdt.py ``_SHARED_JITS`` + module-level jits), so any
+    earlier test that trained over the same shapes leaves them warm and
+    a later training run legitimately records ZERO new compiles —
+    which is exactly what this file must not depend on.  Swap in an
+    empty shared-jit registry and fresh module-level pack jits for the
+    duration, so the test observes a cold process no matter what ran
+    before it (the originals — and their warm executable caches — are
+    restored afterwards)."""
+    from lightgbm_tpu.models import gbdt
+
+    monkeypatch.setattr(gbdt, "_SHARED_JITS", {})
+    # re-jitting the SAME function object would hit jax's
+    # function-identity executable cache and still record nothing; a
+    # fresh closure breaks the identity so the compile really happens
+    raw_pack_words = gbdt._pack_words_padded._fn.__wrapped__
+    raw_pack_tree = gbdt._PACK_TREE._fn.__wrapped__
+
+    def fresh_pack_words(rm, pad):
+        return raw_pack_words(rm, pad)
+
+    def fresh_pack_tree(*args, **kwargs):
+        return raw_pack_tree(*args, **kwargs)
+
+    monkeypatch.setattr(
+        gbdt, "_pack_words_padded",
+        obs.instrumented_jit(fresh_pack_words, program="pack_words",
+                             static_argnames=("pad",)))
+    monkeypatch.setattr(
+        gbdt, "_PACK_TREE",
+        obs.instrumented_jit(fresh_pack_tree, program="pack_tree"))
+
+
+@pytest.fixture
 def ledger_file(tmp_path, monkeypatch):
     """Route the JSONL sink to a temp file for the duration of a test
     via the env var (which wins inside ``configure`` — so an
@@ -92,10 +128,13 @@ def test_nested_jit_calls_not_double_counted():
     assert progs == ["t_outer"]
 
 
-def test_training_populates_ledger(ledger_file):
+def test_training_populates_ledger(ledger_file, fresh_train_programs):
     """End to end: a warmed-then-rerun training session leaves a
     populated ledger (every event has name, shapes, seconds) and re-runs
-    on identical shapes add nothing (acceptance criterion)."""
+    on identical shapes add nothing (acceptance criterion).  Runs
+    against fresh shared training programs so it passes in ANY tier-1
+    order (an earlier training test would otherwise have pre-compiled
+    the process-wide train_step/pack_words jits)."""
     rng = np.random.RandomState(3)
     X = rng.normal(size=(500, 4))
     y = (X[:, 0] > 0).astype(np.float64)

@@ -362,6 +362,179 @@ def test_hot_reload_under_sustained_load(tmp_path):
     assert all(r["generation"] == 2 for r in fleet_stats["replicas"])
 
 
+def test_hedged_retry_on_replica_failure():
+    """A predict failure on one replica is retried on a different one
+    (bounded by retry_limit), counted in serve_retries_total, and the
+    client sees only the good answer."""
+
+    class FlakyForest(StubForest):
+        def __init__(self):
+            super().__init__(value=7.0)
+            self.calls = 0
+
+        def batched_fn(self):
+            def fn(rows):
+                self.calls += 1
+                raise ValueError("injected replica fault")
+            return fn
+
+    flaky = FlakyForest()
+    good = StubForest(value=7.0)
+    reps = [Replica(flaky, 0, "primary", 1, max_batch=64,
+                    max_delay_s=0.0, max_queue=0),
+            Replica(good, 1, "primary", 1, max_batch=64,
+                    max_delay_s=0.0, max_queue=0)]
+    fleet = Fleet(ReplicaSet(reps, "primary", 1), retry_limit=2)
+    r0 = obs.get_counter("serve_retries_total")
+    r0_lbl = obs.get_counter(obs.labeled_name("serve_retries_total",
+                                              model="primary"))
+    # drive until the least-loaded pick lands on the flaky replica at
+    # least once; every submit must still succeed via the hedge
+    for _ in range(8):
+        res = fleet.submit(np.ones((1, 4), np.float32), timeout=10.0)
+        assert float(np.asarray(res.out)[0, 0]) == 7.0
+        assert res.replica == 1                  # the answer came from good
+    assert flaky.calls >= 1, "flaky replica never picked"
+    retries = obs.get_counter("serve_retries_total") - r0
+    assert retries >= flaky.calls
+    assert obs.get_counter(obs.labeled_name(
+        "serve_retries_total", model="primary")) - r0_lbl == retries
+    # the errors marked the replica suspect (watchdog would eject it)
+    assert reps[0].consecutive_errors >= 1 or reps[0].health != "healthy"
+    fleet.close()
+
+
+def test_retry_limit_exhaustion_propagates_original_error():
+    class BrokenForest(StubForest):
+        def __init__(self):
+            super().__init__()
+            self.calls = 0
+
+        def batched_fn(self):
+            def fn(rows):
+                self.calls += 1
+                raise ValueError("always broken")
+            return fn
+
+    reps = [Replica(BrokenForest(), i, "primary", 1, max_batch=64,
+                    max_delay_s=0.0, max_queue=0) for i in range(2)]
+    fleet = Fleet(ReplicaSet(reps, "primary", 1), retry_limit=1)
+    with pytest.raises(ValueError, match="always broken"):
+        fleet.submit(np.ones((1, 4), np.float32), timeout=10.0)
+    fleet.close()
+
+    # single-replica fleet: NO retry against the one replica that just
+    # failed — the original error propagates after exactly one predict,
+    # and the error account grows by one, not retry_limit+1
+    lone = Replica(BrokenForest(), 0, "primary", 1, max_batch=64,
+                   max_delay_s=0.0, max_queue=0)
+    fleet = Fleet(ReplicaSet([lone], "primary", 1), retry_limit=2)
+    r0 = obs.get_counter("serve_retries_total")
+    with pytest.raises(ValueError, match="always broken"):
+        fleet.submit(np.ones((1, 4), np.float32), timeout=10.0)
+    assert lone.forest.calls == 1
+    assert lone.consecutive_errors == 1
+    assert obs.get_counter("serve_retries_total") - r0 == 0
+    fleet.close()
+
+
+def test_canary_with_zero_replicas_falls_back_to_primary():
+    """An all-ejected canary must not turn its traffic share into hard
+    503s while healthy primary capacity sits idle: the canary slice
+    falls back (counted), and recovers once the canary is healthy."""
+    primary = ReplicaSet(_stub_replicas([0.0], value=1.0), "primary", 1)
+    canary = ReplicaSet(_stub_replicas([0.0], model="canary",
+                                       generation=2, value=2.0),
+                        "canary", 2)
+    fleet = Fleet(primary, canary, canary_weight=0.5)
+    from lightgbm_tpu.serve.health import EJECTED, HEALTHY
+    with fleet._cond:
+        canary.replicas[0].health = EJECTED
+    f0 = obs.get_counter("serve_canary_fallback_total")
+    for _ in range(8):
+        res = fleet.submit(np.ones((1, 4), np.float32), timeout=10.0)
+        assert res.model == "primary"            # every request lands
+        assert float(np.asarray(res.out)[0, 0]) == 1.0
+    assert obs.get_counter("serve_canary_fallback_total") - f0 == 4
+    # canary re-admitted: its share comes back
+    with fleet._cond:
+        canary.replicas[0].health = HEALTHY
+    served = {"primary": 0, "canary": 0}
+    for _ in range(8):
+        served[fleet.submit(np.ones((1, 4), np.float32),
+                            timeout=10.0).model] += 1
+    assert served["canary"] == 4
+    fleet.close()
+
+
+def test_reload_failure_paths_leave_generation_untouched(tmp_path):
+    """ModelManager.reload failure matrix (satellite): corrupt model
+    file, warmup raising, width mismatch mid-swap — each error leaves
+    the serving generation and its predictions untouched, and the fleet
+    keeps serving."""
+    from lightgbm_tpu.testing import faults
+
+    path_a, X = _train_and_save(tmp_path, "a.txt", rounds=3)
+    rows3 = X[:3].astype(np.float32)
+    forest = CompiledForest.from_booster(lgb.Booster(model_file=path_a),
+                                         buckets=BUCKETS)
+    forest.warmup(max_bucket=64)
+    # a zero-weight canary pins the request schema so the width-mismatch
+    # arm of the matrix has a live "other" model to collide with
+    fleet = Fleet.build(forest, devices=[None], max_batch=64,
+                        max_delay_s=0.001, warm=False,
+                        canary_forest=forest, canary_weight=0.0)
+    manager = ModelManager(fleet)
+    want = np.asarray(fleet.submit(rows3).out)
+
+    def _assert_untouched():
+        assert fleet.generation == 1
+        res = fleet.submit(rows3)
+        assert res.generation == 1
+        assert np.array_equal(np.asarray(res.out), want)
+
+    # corrupt model file: loader raises, nothing was built
+    corrupt = tmp_path / "corrupt.txt"
+    corrupt.write_bytes(b"\x00\xffnot a model\x13\x37" * 16)
+    with pytest.raises(Exception):
+        manager.reload(str(corrupt))
+    _assert_untouched()
+
+    # warmup raising mid-build: half-built replicas are closed, the
+    # swap never happens
+    path_b, _ = _train_and_save(tmp_path, "b.txt", rounds=5, lr=0.3)
+    with faults.fail_warmup(times=1):
+        with pytest.raises(faults.InjectedCrash):
+            manager.reload(str(path_b))
+    _assert_untouched()
+
+    # width mismatch mid-swap (against the OTHER live model's schema)
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError, match="request schema"):
+        fleet.promote(StubForest(num_features=9), target="primary")
+    _assert_untouched()
+
+    # and a clean reload still works after all three failures
+    # (generation 3: the zero-weight canary holds generation 2)
+    assert manager.reload(str(path_b)) == 3
+    assert fleet.generation == 3
+    fleet.close()
+
+
+def test_restore_path_tolerates_malformed_state(tmp_path):
+    """A damaged/hand-edited state file degrades to the boot model —
+    it must never keep the server from starting."""
+    p = tmp_path / "state.json"
+    for content in (json.dumps({"primary": "old.txt"}),      # not a dict
+                    json.dumps({"primary": {"model": 123}}),  # not a str
+                    json.dumps(["not", "a", "dict"]),
+                    "{broken json"):
+        p.write_text(content)
+        assert ModelManager.restore_path(str(p)) is None
+    assert ModelManager.restore_path(str(tmp_path / "missing.json")) \
+        is None
+
+
 def test_reload_error_paths(tmp_path):
     fleet = Fleet(ReplicaSet(_stub_replicas([0.0]), "primary", 1))
     srv = PredictServer(fleet, port=0).start()
@@ -549,7 +722,10 @@ def test_bench_regress_accepts_fleet_keys(tmp_path, capsys):
                  "concurrency": 4,
                  "fleet": {"1": {"rows_per_sec": 500.0, "shed_rate": 0.0},
                            "2": {"rows_per_sec": 900.0,
-                                 "shed_rate": 0.01}}}
+                                 "shed_rate": 0.01}},
+                 "availability": {"serve_retries_total": 3,
+                                  "serve_ejections_total": 1,
+                                  "serve_deadline_expired_total": 0}}
     b = tmp_path / "base.json"
     c = tmp_path / "cand.json"
     b.write_text(json.dumps(baseline))
@@ -564,3 +740,9 @@ def test_bench_regress_accepts_fleet_keys(tmp_path, capsys):
                                                        "2": 900.0}
     assert verdict["fleet_candidate_shed_rate"] == {"2": 0.01}
     assert "fleet_baseline_rows_per_sec" not in verdict
+    # round 9: the availability counters pass through informationally on
+    # whichever side carries them — and never gate the verdict
+    assert verdict["availability_candidate"] == {
+        "serve_retries_total": 3, "serve_ejections_total": 1,
+        "serve_deadline_expired_total": 0}
+    assert "availability_baseline" not in verdict

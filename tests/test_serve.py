@@ -11,6 +11,7 @@ empty batches) and the CLI's streaming task=predict.
 
 import json
 import threading
+import time
 import urllib.request
 
 import numpy as np
@@ -18,8 +19,8 @@ import pytest
 
 import lightgbm_tpu as lgb
 from lightgbm_tpu import obs
-from lightgbm_tpu.serve import (BucketLadder, CompiledForest, MicroBatcher,
-                                PredictServer, default_ladder)
+from lightgbm_tpu.serve import (BatcherClosed, BucketLadder, CompiledForest,
+                                MicroBatcher, PredictServer, default_ladder)
 
 pytestmark = pytest.mark.serve
 
@@ -283,6 +284,96 @@ def test_microbatcher_max_batch_splits_and_errors_propagate():
     mb.close()
     with pytest.raises(RuntimeError):
         mb.submit(np.ones((1, 2)))
+
+
+# ---------------------------------------------------------------------------
+# shutdown hardening: futures complete or fail — never hang
+
+
+def test_microbatcher_submit_after_close_raises_cleanly():
+    mb = MicroBatcher(lambda rows: (rows.T, rows.T), max_batch=8,
+                      max_delay_s=0.0)
+    mb.close()
+    with pytest.raises(BatcherClosed):
+        mb.submit(np.ones((1, 2)))
+    # idempotent + still clean after a second close
+    mb.close()
+    with pytest.raises(BatcherClosed):
+        mb.submit(np.ones((1, 2)), timeout=1.0)
+
+
+def test_microbatcher_close_fails_queued_when_not_draining():
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow_fn(rows):
+        started.set()
+        release.wait(10.0)
+        return rows.T, rows.T
+
+    mb = MicroBatcher(slow_fn, max_batch=1, max_delay_s=0.0)
+    results = []
+
+    def submit_one():
+        try:
+            results.append(("ok", mb.submit(np.ones((1, 2)), timeout=30.0)))
+        except BaseException as exc:
+            results.append(("err", exc))
+
+    t1 = threading.Thread(target=submit_one)   # picked up, in flight
+    t1.start()
+    assert started.wait(5.0)
+    t2 = threading.Thread(target=submit_one)   # stays queued
+    t2.start()
+    while mb.queue_depth() == 0:
+        time.sleep(0.005)
+    mb.close(drain=False, join_timeout_s=0.2)  # worker still wedged
+    # BOTH futures resolve promptly: the queued one fails on close, the
+    # in-flight one fails via the post-join fallback — neither hangs
+    t2.join(timeout=5.0)
+    t1.join(timeout=5.0)
+    assert not t1.is_alive() and not t2.is_alive(), \
+        "close() left a submit() hanging"
+    assert sorted(kind for kind, _ in results) == ["err", "err"]
+    assert all(isinstance(v, BatcherClosed) for _, v in results)
+    release.set()
+
+
+def test_microbatcher_abort_fails_queued_and_inflight():
+    started = threading.Event()
+    release = threading.Event()
+
+    def wedge_fn(rows):
+        started.set()
+        release.wait(10.0)
+        return rows.T, rows.T
+
+    mb = MicroBatcher(wedge_fn, max_batch=1, max_delay_s=0.0)
+    outcomes = []
+
+    def submit_one():
+        try:
+            mb.submit(np.ones((1, 2)), timeout=30.0)
+            outcomes.append("ok")
+        except RuntimeError as exc:
+            outcomes.append(type(exc).__name__)
+
+    threads = [threading.Thread(target=submit_one) for _ in range(3)]
+    for t in threads:
+        t.start()
+    assert started.wait(5.0)
+
+    class Boom(RuntimeError):
+        pass
+
+    mb.abort(Boom("replica ejected"))
+    for t in threads:
+        t.join(timeout=5.0)
+    assert all(not t.is_alive() for t in threads), "abort left a hang"
+    assert outcomes == ["Boom"] * 3
+    with pytest.raises(BatcherClosed):
+        mb.submit(np.ones((1, 2)))
+    release.set()
 
 
 # ---------------------------------------------------------------------------

@@ -45,7 +45,10 @@ tail comment) or on a quiet runner.
 Round 8's ``bench.py --mode predict --concurrency N`` adds ``fleet`` /
 ``concurrency`` keys (per-replica-count rows/sec + shed rate); they pass
 through into the verdict informationally on whichever side carries them
-and are never required — old baselines keep comparing.
+and are never required — old baselines keep comparing.  Round 9 adds an
+``availability`` block the same way (``serve_retries_total`` /
+``serve_ejections_total`` / ``serve_deadline_expired_total`` deltas over
+the bench run): informational, never gated, never required.
 """
 
 from __future__ import annotations
@@ -169,6 +172,13 @@ def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
                     if isinstance(blk, dict) and blk.get("shed_rate")}
             if shed:
                 verdict[f"fleet_{side}_shed_rate"] = shed
+        # round 9: serving availability counters (hedged retries,
+        # replica ejections, deadline sheds) ride along informationally —
+        # a chaos-y bench run should show its fault bill in the verdict,
+        # but replica health is environment-dependent, so never gated
+        avail = obj.get("availability")
+        if isinstance(avail, dict) and avail:
+            verdict[f"availability_{side}"] = avail
     return verdict
 
 

@@ -30,9 +30,11 @@ call forms (``obs.trace_span("X")`` / ``obs.trace_begin("X")``,
 obs/tracing.py): trace span names are the SAME taxonomy, so a name
 invented at a tracing call site fails here instead of minting an
 unregistered series.  The serving-fleet spans (``Serve::dispatch`` /
-``Serve::reload`` / ``Serve::drain``, serve/fleet.py) ride the same
-rule: declared in HOST_PHASES, used at their call sites, one unique
-``phase_seconds_*`` series each.
+``Serve::reload`` / ``Serve::drain``, serve/fleet.py) and the
+fault-tolerance spans (``Serve::hedge`` on the hedged-retry dispatch
+path, ``Serve::eject`` / ``Serve::probe`` in the health watchdog,
+serve/health.py) ride the same rule: declared in HOST_PHASES, used at
+their call sites, one unique ``phase_seconds_*`` series each.
 
 Runs standalone (``python tools/lint_phase_scopes.py``) and as a tier-1
 test (tests/test_phase_lint.py).  phases.py is loaded by file path so
