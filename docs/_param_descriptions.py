@@ -148,4 +148,30 @@ DESC = {
     "is_predict_raw_score": "predict: output raw scores",
     "is_predict_leaf_index": "predict: output leaf indices",
     "verbosity": "log level",
+    # fault tolerance (docs/FAULT_TOLERANCE.md)
+    "snapshot_dir": "crash-safe snapshot directory; also enables "
+                    "auto-resume (multihost: rank 0 writes, resume runs "
+                    "the cross-rank consensus)",
+    "snapshot_freq": "checkpoint every K iterations (0 = off; alias "
+                     "save_period)",
+    "snapshot_keep": "newest snapshot files retained (0 = keep all)",
+    "nan_policy": "none | fail_fast | skip_tree — non-finite "
+                  "gradient/score containment",
+    "distributed_init_retries": "coordinator-connect retries with "
+                                "exponential backoff",
+    "distributed_init_backoff": "first coordinator-connect retry delay, "
+                                "seconds (doubles each retry)",
+    "distributed_heartbeat_ms": "out-of-band UDP rank-heartbeat interval "
+                                "for the collective watchdog (0 = off; "
+                                "docs/FAULT_TOLERANCE.md §Distributed)",
+    "collective_timeout_s": "per-round collective deadline / peer "
+                            "staleness bound; 0 = auto, derived from "
+                            "the comm_seconds EWMA with a 60 s floor",
+    "distributed_consistency_check": "allgather a replicated-state digest "
+                                     "every K iterations to catch rank "
+                                     "desync (0 = off; zero overhead "
+                                     "single-process)",
+    "desync_policy": "fail_fast | resync — stop the pod with a named "
+                     "diagnostic, or broadcast rank 0's state to the "
+                     "diverged ranks and continue",
 }

@@ -732,12 +732,14 @@ class Booster:
 
     # -- fault tolerance (lightgbm_tpu/snapshot.py) ----------------------
     def save_snapshot(self, directory: str, evals_result=None,
-                      keep: int = 0, rounds_done=None) -> str:
+                      keep: int = 0, rounds_done=None) -> Optional[str]:
         """Write a crash-safe, checksummed training snapshot into
         ``directory`` (atomic tmp + ``os.replace``) and return its path.
         ``engine.train`` does this automatically under
         ``snapshot_freq``/``snapshot_dir``; this is the manual hook for
-        custom ``update()`` loops.  See docs/FAULT_TOLERANCE.md.
+        custom ``update()`` loops.  Under multihost only rank 0 writes
+        (the state is replicated) — other ranks return None.  See
+        docs/FAULT_TOLERANCE.md.
 
         ``rounds_done`` defaults to the booster's successful iteration
         count.  An ``engine.train`` resume treats it as the number of

@@ -34,6 +34,7 @@ model.
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 from typing import Dict, List
@@ -43,6 +44,8 @@ import numpy as np
 from .basic import Booster, Dataset
 from .config import Config, parse_cli_args
 from .engine import train as engine_train
+from .parallel.watchdog import (DISTRIBUTED_ABORT_EXIT_CODE,
+                                DistributedAborted)
 from .utils import log
 
 
@@ -159,7 +162,10 @@ def main(argv=None) -> int:
               "[compile_ledger_file=<jsonl>] [trace_events_file=<json>] "
               "[memwatch=true] "
               "[snapshot_dir=<dir> snapshot_freq=<K>] "
-              "[nan_policy=fail_fast|skip_tree]\n"
+              "[nan_policy=fail_fast|skip_tree] "
+              "[collective_timeout_s=<s> distributed_heartbeat_ms=<ms> "
+              "distributed_consistency_check=<K> "
+              "desync_policy=fail_fast|resync]\n"
               "       python -m lightgbm_tpu serve input_model=<model> "
               "[serve_port=<p> serve_max_batch=<n> serve_max_delay_ms=<ms> "
               "serve_replicas=<k> serve_queue_depth=<n> "
@@ -189,14 +195,27 @@ def main(argv=None) -> int:
     # invocations start hot (utils/compile_cache.py)
     from .utils import compile_cache
     compile_cache.setup(config.compile_cache_dir or None)
-    if config.task == "train":
-        run_train(config, params)
-    elif config.task in ("predict", "prediction", "test"):
-        run_predict(config, params)
-    elif config.task == "serve":
-        run_serve(config, params)
-    else:
-        log.fatal("Unknown task type %s", config.task)
+    try:
+        if config.task == "train":
+            run_train(config, params)
+        elif config.task in ("predict", "prediction", "test"):
+            run_predict(config, params)
+        elif config.task == "serve":
+            run_serve(config, params)
+        else:
+            log.fatal("Unknown task type %s", config.task)
+    except DistributedAborted as e:
+        # a peer rank died/hung and the cooperative watchdog check
+        # tripped (the hard-abort path os._exits with the same code):
+        # exit distinctly so a launcher can key restarts on it — resume
+        # rides the coordinated snapshots (docs/FAULT_TOLERANCE.md).
+        # os._exit, not return: with a dead peer, jax's atexit shutdown
+        # barrier would hang ~100s and then SIGABRT over our code.
+        log.warning("%s; exiting with code %d for the launcher to "
+                    "restart", e, DISTRIBUTED_ABORT_EXIT_CODE)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(DISTRIBUTED_ABORT_EXIT_CODE)
     return 0
 
 

@@ -202,6 +202,16 @@ _DEFAULTS: Dict[str, Any] = {
     "nan_policy": "none",      # none | fail_fast | skip_tree
     "distributed_init_retries": 3,    # coordinator-connect retries
     "distributed_init_backoff": 2.0,  # first retry delay, seconds (x2 each)
+    # distributed fault tolerance (parallel/watchdog.py,
+    # docs/FAULT_TOLERANCE.md §Distributed)
+    "distributed_heartbeat_ms": 500.0,  # out-of-band rank heartbeat
+                                        # interval (0 = watchdog off)
+    "collective_timeout_s": 0.0,  # per-round collective deadline
+                                  # (0 = auto from the comm_seconds EWMA)
+    "distributed_consistency_check": 0,  # allgather a replicated-state
+                                         # digest every K iters (0 = off)
+    "desync_policy": "fail_fast",  # fail_fast | resync (broadcast rank
+                                   # 0's state on divergence)
     # serving (lightgbm_tpu/serve/; docs/SERVING.md)
     "serve_host": "127.0.0.1",  # bind address for task=serve
     "serve_port": 8080,         # HTTP port for task=serve
@@ -378,6 +388,19 @@ class Config:
                 "(expected none, fail_fast, or skip_tree)")
         if v["snapshot_freq"] < 0:
             raise ValueError("snapshot_freq must be >= 0")
+        if v["distributed_heartbeat_ms"] < 0:
+            raise ValueError("distributed_heartbeat_ms must be >= 0 "
+                             "(0 disables the collective watchdog)")
+        if v["collective_timeout_s"] < 0:
+            raise ValueError("collective_timeout_s must be >= 0 (0 = "
+                             "auto, derived from the comm_seconds EWMA)")
+        if v["distributed_consistency_check"] < 0:
+            raise ValueError("distributed_consistency_check must be >= 0 "
+                             "(0 disables the desync detector)")
+        if v["desync_policy"] not in ("fail_fast", "resync"):
+            raise ValueError(
+                f"Unknown desync_policy {v['desync_policy']} "
+                "(expected fail_fast or resync)")
         if v["serve_max_batch"] <= 0:
             raise ValueError("serve_max_batch must be > 0")
         if not (0 <= v["metrics_port"] < 65536):

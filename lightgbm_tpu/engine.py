@@ -82,8 +82,15 @@ def train(params, train_set, num_boost_round=100,
                     "snapshots are DISABLED", snapshot_freq)
     resume_state = None
     if snapshot_dir:
-        from .snapshot import load_latest_snapshot
-        found = load_latest_snapshot(snapshot_dir)
+        # multihost resume goes through the cross-rank consensus
+        # (docs/FAULT_TOLERANCE.md §Distributed): all ranks agree on the
+        # minimum common valid iteration and verify byte-identical files
+        # before any round trains; single-process keeps the plain path.
+        from .parallel.multihost import process_rank_world
+        from .snapshot import coordinated_resume, load_latest_snapshot
+        found = (coordinated_resume(snapshot_dir)
+                 if process_rank_world()[1] > 1
+                 else load_latest_snapshot(snapshot_dir))
         if found is not None:
             resume_path, resume_state = found
             if init_model is not None:
@@ -223,6 +230,15 @@ def train(params, train_set, num_boost_round=100,
     from .obs.metrics_server import maybe_start as _maybe_start_metrics
     metrics_server = _maybe_start_metrics(params)
 
+    # a collective-watchdog hard abort (parallel/watchdog.py) bypasses
+    # this function's finally block (os._exit while the loop is wedged
+    # in a collective): hand the recorder to the watchdog so the event
+    # stream is drained before the process dies
+    from .parallel.watchdog import active_watchdog
+    _watchdog = active_watchdog()
+    if _watchdog is not None and recorder is not None:
+        _watchdog.register_flush(recorder.close)
+
     # boosting loop (engine.py:143-203)
     try:
         for i in range(init_iteration + resume_done,
@@ -281,6 +297,8 @@ def train(params, train_set, num_boost_round=100,
             booster._booster.set_event_recorder(None)
         if metrics_server is not None:
             metrics_server.stop()
+        if _watchdog is not None and recorder is not None:
+            _watchdog.unregister_flush(recorder.close)
         # flush the causal span tree (one trace per boosting round) to
         # the configured Chrome trace-event file
         _tracing.TRACER.maybe_export()

@@ -456,6 +456,15 @@ class GBDT:
         self._trace = obs.TraceCapture.from_config(cfg)
         self._nan_policy = str(getattr(cfg, "nan_policy", "none") or "none")
         self._nan_skips = 0
+        # distributed desync detection (docs/FAULT_TOLERANCE.md
+        # §Distributed): every K rounds, allgather a cheap digest of the
+        # replicated state and verify every rank agrees.  Zero overhead
+        # single-process: the gate short-circuits on world size before
+        # touching anything (no collectives, no compiles).
+        self._consistency_every = int(
+            getattr(cfg, "distributed_consistency_check", 0) or 0)
+        self._desync_policy = str(
+            getattr(cfg, "desync_policy", "fail_fast") or "fail_fast")
         self._bag_cnt = self.num_data
         self._bag_key = jax.random.PRNGKey(cfg.bagging_seed)
         self._feature_rng = np.random.RandomState(cfg.feature_fraction_seed)
@@ -985,8 +994,144 @@ class GBDT:
         ``phase_seconds_gbdt_iteration`` wall-time histogram per call —
         host bookkeeping only, the async device pipeline is never synced
         by it (docs/OBSERVABILITY.md)."""
+        # desync check runs at round ENTRY, before this round's gradients
+        # consume the (possibly diverged) state: for the built-in
+        # objectives (gradients computed inside the impl) a resync here
+        # restores the clean trajectory BEFORE a poisoned rank's
+        # gradients can leak into the round's cross-process histogram
+        # sums.  Custom-fobj gradients arrive precomputed from upstream
+        # — one post-resync round still trains on them (consistent
+        # pod-wide, flagged below; the next round is clean).
+        resynced = self._maybe_check_consistency()
+        if resynced and grad is not None:
+            log.warning(
+                "desync resync at iteration %d arrived after this "
+                "round's custom-objective gradients were computed from "
+                "the pre-resync scores; the pod stays consistent but "
+                "this one round ingests the stale gradients", self.iter_)
         with obs.span("GBDT::iteration"):
             return self._train_one_iter_impl(grad, hess)
+
+    # -- distributed desync detection ----------------------------------
+    def _maybe_check_consistency(self) -> bool:
+        """Every ``distributed_consistency_check`` rounds under a
+        multi-process runtime, verify the replication invariant the
+        module header of parallel/multihost.py only states in prose:
+        every rank holds identical trees, score caches and RNG streams.
+        Single-process (or K=0): returns before touching jax — no new
+        collectives, no new compiles.  Returns True when a resync
+        restored state on any rank."""
+        K = self._consistency_every
+        if K <= 0:
+            return False
+        from ..parallel.multihost import process_rank_world
+        rank, world = process_rank_world()
+        if world <= 1:
+            return False
+        it = self.iter_ - self.num_init_iteration
+        if it <= 0 or it % K != 0:
+            return False
+        # same guard as Comm::grow: a rank dying during THIS allgather
+        # must become a bounded named abort, not a silent hang
+        from ..parallel.watchdog import active_watchdog
+        wd = active_watchdog()
+        with obs.span("Dist::consistency"):
+            if wd is not None:
+                with wd.guard("Dist::consistency"):
+                    return self._check_distributed_consistency(rank, world)
+            return self._check_distributed_consistency(rank, world)
+
+    def _consistency_digests(self) -> Dict[str, int]:
+        """Cheap per-field uint64 digests of the replicated training
+        state (flushes the pipelined iteration first so every rank
+        digests the synchronous view).  Field granularity is what makes
+        the divergence diagnostic name WHAT desynced, not just that
+        something did."""
+        import hashlib
+        import pickle
+
+        self._flush_pending()
+
+        def d(blob: bytes) -> int:
+            return int.from_bytes(hashlib.sha256(blob).digest()[:8],
+                                  "little")
+
+        return {
+            "iter": d(np.int64([self.iter_, len(self._models)]).tobytes()),
+            "trees": d(pickle.dumps(self._models,
+                                    protocol=pickle.HIGHEST_PROTOCOL)),
+            "score": d(self.train_data.host_score(np.float32).tobytes()),
+            "rng": d(np.asarray(self._bag_key).tobytes()
+                     + pickle.dumps(self._feature_rng.get_state())
+                     + np.asarray(self._row_weight).tobytes()),
+        }
+
+    def _check_distributed_consistency(self, rank: int,
+                                       world: int) -> bool:
+        """Allgather the per-field digests, compare, and apply
+        ``desync_policy`` on divergence: ``fail_fast`` dies with a
+        diagnostic naming the diverged rank(s) and field(s) (the same
+        allgather runs on every rank, so the whole pod stops together);
+        ``resync`` broadcasts rank 0's full snapshot state and restores
+        it on the divergent ranks, then training continues (returns
+        True)."""
+        from ..parallel.comm import allgather_host_array, \
+            broadcast_host_bytes
+        fields = self._consistency_digests()
+        names = list(fields)
+        mine = np.array([fields[n] for n in names], np.uint64)
+        gathered = np.asarray(allgather_host_array(mine))  # [world, F]
+        if bool((gathered == gathered[0]).all()):
+            return False
+        obs.inc("desync_detected_total")
+        diverged: Dict[str, List[int]] = {}
+        for fi, name in enumerate(names):
+            col = gathered[:, fi]
+            vals, counts = np.unique(col, return_counts=True)
+            top = int(counts.max())
+            majority = {int(v) for v, c in zip(vals, counts)
+                        if int(c) == top}
+            # majority wins; ties (e.g. any 2-process pod) defer to
+            # rank 0, consistent with resync trusting rank 0's state
+            ref = (int(col[0]) if int(col[0]) in majority
+                   else next(iter(sorted(majority))))
+            bad = [r for r in range(world) if int(col[r]) != ref]
+            if bad:
+                diverged[name] = bad
+        detail = "; ".join(
+            f"field {name!r} diverged on rank(s) {bad}"
+            for name, bad in diverged.items())
+        if self._desync_policy == "fail_fast":
+            log.fatal(
+                "distributed state desync detected at iteration %d "
+                "(%d-process run): %s.  Every rank must hold identical "
+                "replicated training state; set desync_policy=resync to "
+                "broadcast rank 0's state instead of stopping, and see "
+                "docs/FAULT_TOLERANCE.md §Distributed.",
+                self.iter_, world, detail)
+        if any(0 in bad for bad in diverged.values()):
+            # resync trusts rank 0; the majority just voted rank 0 THE
+            # diverged one (only possible at world >= 3 — 2-rank ties
+            # defer to rank 0).  Broadcasting its state would propagate
+            # the corruption pod-wide while logging "healed": refuse.
+            log.fatal(
+                "distributed state desync detected at iteration %d: %s — "
+                "rank 0 is the resync source of truth but is itself the "
+                "diverged rank; refusing to propagate its state "
+                "(desync_policy=resync falls back to failing fast here).",
+                self.iter_, detail)
+        import pickle
+        log.warning("distributed state desync detected at iteration %d: "
+                    "%s — resyncing every rank from rank 0's state",
+                    self.iter_, detail)
+        payload = (pickle.dumps(self.snapshot_state(),
+                                protocol=pickle.HIGHEST_PROTOCOL)
+                   if rank == 0 else None)
+        blob = broadcast_host_bytes(payload, is_source=(rank == 0))
+        if rank != 0:
+            self.restore_state(pickle.loads(blob))
+        obs.inc("desync_resyncs_total")
+        return True
 
     def _train_one_iter_impl(self, grad=None, hess=None) -> bool:
         """Body of one boosting round.

@@ -57,9 +57,26 @@ class EventRecorder:
 
     def __init__(self, path: str):
         self._path = str(path)
-        self._fh = open(self._path, "w")
         self._pending: Dict[int, Dict[str, Any]] = {}
         self._written = 0
+        # multihost: stamp every record with this process's rank so
+        # obs-report over merged per-rank files can attribute stragglers
+        # (single-process streams stay unchanged — no rank field).  The
+        # path is suffixed per rank too: every rank receives the SAME
+        # events_file from the one conf, and N ranks opening one shared
+        # path with mode "w" would truncate each other's streams.
+        self._rank: Any = None
+        try:
+            from ..parallel.multihost import process_rank_world
+            rank, world = process_rank_world()
+            if world > 1:
+                self._rank = int(rank)
+                import os
+                root, ext = os.path.splitext(self._path)
+                self._path = f"{root}.rank{rank}{ext or '.jsonl'}"
+        except Exception:
+            pass
+        self._fh = open(self._path, "w")
 
     # -- producers -------------------------------------------------------
     def note(self, iteration: int, **fields: Any) -> None:
@@ -81,6 +98,8 @@ class EventRecorder:
     def _commit(self, it: int) -> None:
         rec = self._pending.pop(it)
         line = {"schema": SCHEMA_VERSION, "iter": it}
+        if self._rank is not None:
+            line["rank"] = self._rank
         line.update(rec)
         self._fh.write(json.dumps(_sanitize(line), default=_json_default)
                        + "\n")

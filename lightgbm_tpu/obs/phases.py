@@ -30,6 +30,11 @@ HOST_PHASES = frozenset({
     "GBDT::valid_score",
     "GBDT::host_tree",
     "GBDT::metric",
+    # distributed training (parallel/multihost.py, models/gbdt.py)
+    "Comm::grow",         # one round's cross-process growth, collectives
+                          # included (promote -> grow -> gather)
+    "Dist::consistency",  # periodic replicated-state digest allgather
+                          # (distributed_consistency_check)
     # serving subsystem (lightgbm_tpu/serve/, docs/SERVING.md)
     "Serve::request",     # whole HTTP request (causal-trace root)
     "Serve::queue",       # enqueue -> coalesced-batch pickup wait

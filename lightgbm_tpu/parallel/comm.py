@@ -97,6 +97,50 @@ def observe_traffic(traffic, trees: int = 1) -> None:
                     buckets=obs.DEFAULT_BYTE_BUCKETS)
 
 
+# ---------------------------------------------------------------------------
+# Host-side (out-of-jit) collectives.
+#
+# The fault-tolerance layer needs a handful of tiny cross-process
+# exchanges that run on the HOST between rounds — resume consensus over
+# snapshot iterations (snapshot.coordinated_resume), desync-digest
+# comparison and state re-broadcast (models/gbdt.py) — not inside the
+# jitted growers.  They live here, next to the in-jit strategies, so the
+# comm layer owns every byte that crosses processes; tests monkeypatch
+# these two names to simulate multi-rank gathers in one process.
+# ---------------------------------------------------------------------------
+
+def allgather_host_array(x):
+    """All-gather one small replicated host array: every process
+    contributes its local value and receives the ``[P, ...]`` stack
+    (identity reshape-to-[1, ...] when single-process)."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(np.asarray(x)))
+
+
+def broadcast_host_bytes(payload, is_source: bool) -> bytes:
+    """Broadcast an arbitrary byte string from the source rank to every
+    process: lengths first (a tiny allgather, so every rank pads to the
+    same word count), then ONE ``broadcast_one_to_all`` of the payload
+    viewed as int32 words.  The word view keeps the wire/host cost at
+    1x the payload (an astype would 4x it), and a true broadcast — not
+    an allgather — keeps a resync payload (full booster state, possibly
+    hundreds of MB) from materializing a [P, n] gather on every rank."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+    n = int(len(payload)) if is_source else 0
+    # single-process process_allgather returns the value unstacked;
+    # normalize to the [P] view max() expects
+    lens = np.atleast_1d(allgather_host_array(np.int64(n)))
+    size = int(lens.max())
+    buf = np.zeros(size + (-size) % 4, np.uint8)
+    if is_source:
+        buf[:size] = np.frombuffer(payload, np.uint8)
+    out = multihost_utils.broadcast_one_to_all(buf.view(np.int32),
+                                               is_source=is_source)
+    return np.ascontiguousarray(out).view(np.uint8)[:size].tobytes()
+
+
 def _allgather_combine(split: BestSplit, axis_name: str,
                        num_shards: int) -> BestSplit:
     """Allreduce(SplitInfo::MaxReducer): tiny all_gather + tournament."""

@@ -33,22 +33,37 @@ from ..utils import log
 
 
 def parse_machine_list(path: str) -> List[Tuple[str, int]]:
-    """``ip port`` per line (config.h machine_list_file format)."""
+    """``ip port`` per line (config.h machine_list_file format).
+
+    Every diagnostic names the file and line number, and duplicate
+    ``host port`` entries are fatal HERE — letting them through used to
+    surface minutes later as find_process_id's confusing "matches this
+    host N times" (a duplicated line is a broken list, not a
+    several-processes-per-machine setup)."""
     out: List[Tuple[str, int]] = []
+    seen: dict = {}
     with open(path) as fh:
-        for line in fh:
+        for line_no, line in enumerate(fh, 1):
             line = line.strip()
             if not line or line.startswith("#"):
                 continue
             parts = line.replace(",", " ").split()
             if len(parts) < 2:
-                log.fatal("machine_list_file: malformed line %r", line)
+                log.fatal("machine_list_file %s line %d: malformed entry "
+                          "%r (expected 'ip port')", path, line_no, line)
             try:
                 port = int(parts[1])
             except ValueError:
-                log.fatal("machine_list_file: port %r on line %r is not an "
-                          "integer", parts[1], line)
-            out.append((parts[0], port))
+                log.fatal("machine_list_file %s line %d: port %r is not "
+                          "an integer", path, line_no, parts[1])
+            entry = (parts[0], port)
+            if entry in seen:
+                log.fatal("machine_list_file %s line %d: duplicate entry "
+                          "'%s %d' (first seen on line %d) — every "
+                          "process needs a distinct host:port pair",
+                          path, line_no, parts[0], port, seen[entry])
+            seen[entry] = line_no
+            out.append(entry)
     return out
 
 
@@ -93,6 +108,25 @@ def find_process_id(machines: List[Tuple[str, int]]) -> Optional[int]:
     return matches[0] if matches else None
 
 
+def process_rank_world() -> Tuple[int, int]:
+    """``(process_index, process_count)`` WITHOUT initializing a backend
+    in single-process runs: reads the distributed service state directly
+    (a backend-initializing jax call before ``distributed.initialize``
+    would make the later init illegal — see
+    maybe_initialize_distributed).  Single-process: ``(0, 1)``."""
+    try:
+        from jax._src import distributed as _dist
+        if getattr(_dist.global_state, "coordinator_address", None) is None:
+            return 0, 1
+    except Exception:  # pragma: no cover - private-API drift
+        return 0, 1
+    import jax
+    try:
+        return int(jax.process_index()), int(jax.process_count())
+    except Exception:  # pragma: no cover - mid-init races
+        return 0, 1
+
+
 def globalize_grow_fn(grow_fn, mesh):
     """Bridge a mesh-jitted grow fn into a per-process training loop.
 
@@ -133,35 +167,68 @@ def globalize_grow_fn(grow_fn, mesh):
             np.asarray(a), mesh, PartitionSpec())
 
     def wrapped(*args):
+        import contextlib
         import time as _time
         from .. import obs
+        from . import watchdog as _watchdog
+        wd = _watchdog.active_watchdog()
         t0 = _time.perf_counter()
-        glob = []
-        for i, a in enumerate(args):
-            if i < 3:
-                hit = static_cache.get(i)
-                if hit is None or hit[0] is not a:
-                    static_cache[i] = (a, _promote(a))
-                glob.append(static_cache[i][1])
-            else:
-                glob.append(_promote(a))
-        tree, leaf_id, delta = grow_fn(*glob)
-        # tree is replicated: every process holds the full value as its
-        # one addressable shard.  leaf_id and delta are row-sharded over
-        # processes -> all-gather them back to every process.
-        tree = jax.tree.map(
-            lambda x: jax.numpy.asarray(x.addressable_data(0)), tree)
-        leaf_id = jax.numpy.asarray(
-            multihost_utils.process_allgather(leaf_id, tiled=True))
-        delta = jax.numpy.asarray(
-            multihost_utils.process_allgather(delta, tiled=True))
+        # Comm::grow: the whole cross-process round — promote, grow,
+        # gather.  An obs.span (not a raw perf_counter pair) so the
+        # collective time lands in the phase_seconds histogram, the
+        # causal trace export, and obs-report --traces; the watchdog
+        # phase arms the deadline/peer-death guard around the same
+        # region (a dead rank mid-psum trips DistributedAborted here
+        # instead of hanging the pod).
+        def grow_round():
+            glob = []
+            for i, a in enumerate(args):
+                if i < 3:
+                    hit = static_cache.get(i)
+                    if hit is None or hit[0] is not a:
+                        static_cache[i] = (a, _promote(a))
+                    glob.append(static_cache[i][1])
+                else:
+                    glob.append(_promote(a))
+            tree, leaf_id, delta = grow_fn(*glob)
+            # tree is replicated: every process holds the full value as
+            # its one addressable shard.  leaf_id and delta are
+            # row-sharded over processes -> all-gather them back to
+            # every process.
+            tree = jax.tree.map(
+                lambda x: jax.numpy.asarray(x.addressable_data(0)), tree)
+            leaf_id = jax.numpy.asarray(
+                multihost_utils.process_allgather(leaf_id, tiled=True))
+            delta = jax.numpy.asarray(
+                multihost_utils.process_allgather(delta, tiled=True))
+            return tree, leaf_id, delta
+
+        try:
+            with obs.span("Comm::grow"):
+                with (wd.phase("Comm::grow") if wd is not None
+                      else contextlib.nullcontext()):
+                    tree, leaf_id, delta = grow_round()
+        except _watchdog.DistributedAborted:
+            raise
+        except Exception as e:
+            # gloo surfaces a killed peer as a connection error instead
+            # of a hang: let the watchdog wait for the heartbeats to
+            # confirm the death (-> named abort with the distinct exit
+            # code) before the raw error is allowed to unwind
+            if wd is not None:
+                wd.classify_collective_error(e, "Comm::grow")
+            raise
         # per-tree wall time of the cross-process growth, including its
         # collectives — the process_allgather above synchronized, so this
         # is a real (not dispatch-only) duration.  Every rank records its
         # own comm_seconds histogram; scraped per rank (metrics_server's
         # rank label) or folded with registry.merge, the distribution is
-        # the straggler detector.
-        obs.observe("comm_seconds", _time.perf_counter() - t0)
+        # the straggler detector.  The same sample feeds the watchdog's
+        # EWMA, from which the auto collective timeout derives.
+        dt = _time.perf_counter() - t0
+        obs.observe("comm_seconds", dt)
+        if wd is not None:
+            wd.note_comm_seconds(dt)
         return tree, leaf_id, delta
 
     return wrapped
@@ -241,10 +308,24 @@ def maybe_initialize_distributed(config) -> bool:
     # distributed service state directly.
     try:
         from jax._src import distributed as _dist
-        if getattr(_dist.global_state, "coordinator_address", None):
-            return True  # already initialized by the launcher
+        already = bool(getattr(_dist.global_state,
+                               "coordinator_address", None))
     except Exception:  # pragma: no cover - private-API drift
-        pass
+        already = False
+    if already:
+        # already initialized by the launcher: the machine list is only
+        # needed to arm the watchdog, so a stale/bad file degrades to a
+        # warning — it must not kill a healthy launcher-managed run
+        # (and nothing here may fall through to a second initialize)
+        try:
+            machines = parse_machine_list(mlist)[:num_machines]
+            _maybe_start_watchdog(config, machines,
+                                  process_rank_world()[0])
+        except Exception as e:
+            log.warning("launcher-initialized run: machine_list_file %s "
+                        "is unusable for the collective watchdog (%s); "
+                        "watchdog disabled", mlist, e)
+        return True
     machines = parse_machine_list(mlist)
     if len(machines) < num_machines:
         log.fatal("machine_list_file has %d entries but num_machines=%d",
@@ -254,6 +335,7 @@ def maybe_initialize_distributed(config) -> bool:
     if pid is None:
         log.fatal("Could not find the local machine in machine_list_file; "
                   "set LIGHTGBM_TPU_PROCESS_ID explicitly")
+    _maybe_enable_cpu_collectives()
     host, port = machines[0]
     log.info("jax.distributed: coordinator %s:%d, process %d/%d",
              host, port, pid, num_machines)
@@ -266,4 +348,48 @@ def maybe_initialize_distributed(config) -> bool:
         backoff_s=float(getattr(config, "distributed_init_backoff", 2.0)
                         or 0.0),
         timeout_s=timeout_s)
+    _maybe_start_watchdog(config, machines, pid)
     return True
+
+
+def _maybe_enable_cpu_collectives() -> None:
+    """Multi-process collectives on the CPU backend need a cross-process
+    implementation (gloo); the default has none, and the gap surfaces
+    only mid-round as "Multiprocess computations aren't implemented on
+    the CPU backend".  Opt in automatically when the run EXPLICITLY
+    targets cpu (``JAX_PLATFORMS=cpu`` / the ``jax_platforms`` option —
+    how CPU rigs are driven here), so reference multi-machine confs work
+    from the CLI.  A machine whose platform is left to autodetection is
+    not touched: we cannot know the backend without initializing it."""
+    import jax
+    platforms = (os.environ.get("JAX_PLATFORMS", "")
+                 or str(getattr(jax.config, "jax_platforms", None) or ""))
+    if "cpu" not in [p.strip() for p in platforms.split(",")]:
+        return
+    try:
+        # not a plain attribute on this jax build; the raw option table is
+        cur = getattr(jax.config, "values", {}).get(
+            "jax_cpu_collectives_implementation")
+    except Exception:  # pragma: no cover - option renamed/removed
+        return
+    if cur in (None, "", "none"):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            log.info("cpu backend: enabling gloo cross-process collectives")
+        except Exception as e:  # pragma: no cover - jax build drift
+            log.warning("could not enable gloo cpu collectives: %s", e)
+
+
+def _maybe_start_watchdog(config, machines: List[Tuple[str, int]],
+                          pid: int):
+    """Arm the collective watchdog (parallel/watchdog.py) for this rank
+    once the distributed runtime is up.  ``distributed_heartbeat_ms=0``
+    disables it; a mesh bind failure degrades to a warning."""
+    hb_ms = float(getattr(config, "distributed_heartbeat_ms", 0.0) or 0.0)
+    if hb_ms <= 0:
+        return None
+    from . import watchdog as wdmod
+    return wdmod.start_watchdog(
+        machines, int(pid), heartbeat_s=hb_ms / 1000.0,
+        timeout_s=float(getattr(config, "collective_timeout_s", 0.0)
+                        or 0.0))

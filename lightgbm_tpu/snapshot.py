@@ -29,8 +29,19 @@ fsync + ``os.replace`` so a crash mid-write can never produce a file
 that parses.  ``read_snapshot`` returns None for anything that fails
 the magic/length/checksum/unpickle gauntlet, and
 ``load_latest_snapshot`` walks the directory newest-first, skipping
-corrupt files with a warning — a torn or truncated newest snapshot
-falls back to the previous one.
+corrupt files with a warning naming the file (and a
+``snapshot_corrupt_skipped_total`` counter) — a torn or truncated
+newest snapshot falls back to the previous one.
+
+Multihost (docs/FAULT_TOLERANCE.md §Distributed): the training state is
+replicated across ranks, so ``save_snapshot`` writes on rank 0 ONLY —
+N concurrent writers into one ``snapshot_dir`` would race
+``prune_snapshots`` and each other's temp files for zero extra
+durability.  Each record carries a ``world`` block (process count, rank,
+digest of the replicated booster state), and resume runs
+``coordinated_resume``: all ranks agree on the minimum common valid
+iteration and verify they loaded byte-identical files, so a restarted
+pod can never resume desynced.
 
 See docs/FAULT_TOLERANCE.md for the user-facing contract.
 """
@@ -136,6 +147,8 @@ def load_latest_snapshot(directory: str) \
         state = read_snapshot(path)
         if state is not None:
             return path, state
+        from . import obs
+        obs.inc("snapshot_corrupt_skipped_total")
         log.warning("snapshot %s is corrupt or truncated; falling back "
                     "to an older snapshot", path)
     return None
@@ -154,6 +167,43 @@ def prune_snapshots(directory: str, keep: int) -> None:
 
 
 # ---------------------------------------------------------------------------
+# multihost discipline (docs/FAULT_TOLERANCE.md §Distributed)
+# ---------------------------------------------------------------------------
+
+def _rank_world() -> Tuple[int, int]:
+    """(process_index, process_count); (0, 1) outside a distributed
+    runtime, without initializing a jax backend."""
+    try:
+        from .parallel.multihost import process_rank_world
+        return process_rank_world()
+    except Exception:  # pragma: no cover - jax unavailable
+        return 0, 1
+
+
+def is_snapshot_writer() -> bool:
+    """Under multihost the booster state is replicated, so ONE rank owns
+    the snapshot directory: rank 0 writes, everyone reads.  Concurrent
+    writers would race ``prune_snapshots`` (a file rank 1 is fsyncing
+    can be unlinked by rank 0's prune) and each other's ``.tmp`` files
+    for zero added durability."""
+    return _rank_world()[0] == 0
+
+
+def replicated_state_digest(gb) -> str:
+    """Hex fingerprint of a booster's replicated training state, built
+    from the SAME per-field digests the desync detector allgathers
+    (``GBDT._consistency_digests``: iter/trees/score/rng) — cheap (no
+    second full-state pickle) and directly comparable across ranks'
+    logs when debugging a desync.  Recorded in each snapshot's ``world``
+    block; the resume consensus verifies the stronger property (raw
+    file bytes identical across ranks) separately."""
+    fields = gb._consistency_digests()
+    blob = b"".join(k.encode() + int(v).to_bytes(8, "little")
+                    for k, v in sorted(fields.items()))
+    return hashlib.sha256(blob).hexdigest()
+
+
+# ---------------------------------------------------------------------------
 # booster capture / restore glue
 # ---------------------------------------------------------------------------
 
@@ -166,10 +216,23 @@ def capture_booster_state(booster, rounds_done: int,
     from . import obs
     gb = booster._booster
     obs_snap = obs.snapshot()
+    rank, world = _rank_world()
+    booster_state = gb.snapshot_state()
     return {
         "version": SNAPSHOT_VERSION,
         "rounds_done": int(rounds_done),
-        "booster": gb.snapshot_state(),
+        # who wrote this, out of how many, over what state: resume
+        # consensus refuses a snapshot from a differently-sized pod
+        # (num_processes) and verifies byte-identical files across
+        # ranks; the digest is the desync detector's field fingerprint,
+        # for debugging which rank/field drifted (single-process
+        # snapshots, which nothing compares, skip it)
+        "world": {
+            "num_processes": int(world),
+            "rank": int(rank),
+            "digest": (replicated_state_digest(gb) if world > 1 else ""),
+        },
+        "booster": booster_state,
         "evals_result": (copy.deepcopy(evals_result)
                          if evals_result else None),
         "best_iteration": int(booster.best_iteration),
@@ -203,10 +266,96 @@ def restore_booster_state(booster, state: Dict[str, Any]) -> int:
 
 def save_snapshot(directory: str, booster, rounds_done: int,
                   evals_result: Optional[dict] = None,
-                  keep: int = 0) -> str:
+                  keep: int = 0) -> Optional[str]:
     """Capture + atomically write one snapshot; prune old files when
-    ``keep > 0``.  Returns the written path."""
+    ``keep > 0``.  Returns the written path — or None on non-zero ranks
+    under multihost, where the replicated state is rank 0's to write
+    (``is_snapshot_writer``)."""
+    if not is_snapshot_writer():
+        log.warn_once("snapshot_writer_rank",
+                      "snapshots are written by rank 0 only (state is "
+                      "replicated); this rank skips the write")
+        return None
     state = capture_booster_state(booster, rounds_done, evals_result)
     path = write_snapshot(snapshot_path(directory, rounds_done), state)
     prune_snapshots(directory, keep)
     return path
+
+
+def coordinated_resume(directory: str) \
+        -> Optional[Tuple[str, Dict[str, Any]]]:
+    """Multihost resume consensus: every rank reports its newest VALID
+    snapshot iteration, the pod agrees on the minimum, and each rank
+    verifies it loaded the byte-identical file — so a restarted pod can
+    never resume desynced (one rank on round 40, the rest on 50, every
+    later collective silently mixing different models).
+
+    Returns the same ``(path, state)`` on every rank, or None everywhere
+    when any rank has no usable snapshot (a fresh start is the only
+    state all ranks can agree on).  Single-process: plain
+    ``load_latest_snapshot``."""
+    rank, world = _rank_world()
+    if world <= 1:
+        return load_latest_snapshot(directory)
+    import contextlib
+
+    from .parallel.watchdog import active_watchdog
+    wd = active_watchdog()
+    # same guard as Comm::grow: a rank dying during the consensus
+    # allgathers must become a bounded named abort, not a silent hang
+    with (wd.guard("Dist::resume") if wd is not None
+          else contextlib.nullcontext()):
+        return _coordinated_resume_body(directory, rank, world)
+
+
+def _coordinated_resume_body(directory: str, rank: int, world: int) \
+        -> Optional[Tuple[str, Dict[str, Any]]]:
+    import numpy as np
+
+    from .parallel.comm import allgather_host_array
+    found = load_latest_snapshot(directory)
+    newest = -1 if found is None else int(found[1].get("rounds_done", 0))
+    got = allgather_host_array(np.int64(newest))
+    agreed = int(got.min())
+    if agreed < 0:
+        if int(got.max()) >= 0:
+            have = [i for i, v in enumerate(got) if int(v) >= 0]
+            log.warning(
+                "resume consensus: rank(s) %s hold snapshots but rank(s) "
+                "%s hold none — snapshot_dir is not shared or was "
+                "partially cleared; the pod starts FRESH (the only state "
+                "every rank can agree on)", have,
+                [i for i in range(len(got)) if i not in have])
+        return None
+    if agreed != newest:
+        log.warning("resume consensus: this rank's newest snapshot holds "
+                    "%d rounds but the pod agrees on %d; resuming from "
+                    "the common iteration", newest, agreed)
+    path = snapshot_path(directory, agreed)
+    state = read_snapshot(path)
+    if state is None:
+        log.fatal("resume consensus agreed on %s but rank %d cannot read "
+                  "it; clear snapshot_dir (or restore the file) and "
+                  "restart the pod", path, rank)
+    w = state.get("world") or {}
+    if w and int(w.get("num_processes", world)) != world:
+        log.fatal("snapshot %s was written by a %d-process run but this "
+                  "pod has %d processes; the replicated state is only "
+                  "meaningful at the same world size", path,
+                  int(w["num_processes"]), world)
+    # every rank must have loaded the byte-identical file (per-host disks
+    # can hold diverged copies of the "same" snapshot)
+    with open(path, "rb") as fh:
+        blob = fh.read()
+    mine = np.frombuffer(hashlib.sha256(blob).digest()[:8],
+                         np.uint64)[0]
+    digests = allgather_host_array(np.uint64(mine))
+    if int((digests != digests[0]).sum()):
+        bad = [i for i, d in enumerate(digests) if int(d) != int(digests[0])]
+        log.fatal("resume consensus: snapshot %s differs across ranks "
+                  "(rank(s) %s hold different bytes than rank 0); refusing "
+                  "to resume desynced — re-replicate the snapshot "
+                  "directory and restart", path, bad)
+    log.info("resume consensus: %d ranks agreed on %s (%d rounds done)",
+             world, path, agreed)
+    return path, state

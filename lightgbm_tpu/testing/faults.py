@@ -14,6 +14,14 @@ fragments:
   the atomic tmp+``os.replace`` protocol and checksum fallback);
 - ``truncate_file`` / ``flip_byte``: corrupt a file on disk after the
   fact (bit rot / torn storage on an already-written snapshot);
+- rank-level injectors (the distributed chaos suite in
+  tests/test_dist_chaos.py, marker ``dist_chaos``): callback factories
+  for ``engine.train``'s callback seam, each gated to ONE rank of a
+  multi-process run — ``kill_rank`` (SIGKILL at a boosting iteration:
+  the preempted worker), ``hang_rank`` (the iteration blocks: a wedged
+  host), ``delay_rank`` (added per-iteration latency: the straggler),
+  ``corrupt_rank_state`` (silently perturb one rank's replicated score
+  cache or trees: the desync the consistency check exists for);
 - serving injectors (PR 9, the chaos suite in tests/test_serve_chaos.py,
   marker ``chaos``): ``wedge_replica`` (a replica's device predict
   blocks until release — the classic hung-device failure),
@@ -151,6 +159,117 @@ def truncate_file(path: str, keep_bytes: Optional[int] = None) -> None:
     keep = size // 2 if keep_bytes is None else max(int(keep_bytes), 0)
     with open(path, "r+b") as fh:
         fh.truncate(keep)
+
+
+# ---------------------------------------------------------------------------
+# rank-level fault injectors (parallel/ fault tolerance,
+# docs/FAULT_TOLERANCE.md §Distributed)
+#
+# These are CALLBACK factories, not context managers: the failure seam
+# is a boosting iteration of a specific rank inside engine.train's
+# callback-driven loop (the distributed chaos workers pass them via
+# ``callbacks=[...]``), and the kill/hang variants never return to
+# restore anything anyway.
+
+
+def _this_rank() -> int:
+    try:
+        from ..parallel.multihost import process_rank_world
+        return process_rank_world()[0]
+    except Exception:
+        return 0
+
+
+def _rank_matches(rank: Optional[int]) -> bool:
+    return rank is None or int(rank) == _this_rank()
+
+
+def kill_rank(at_iteration: int, rank: Optional[int] = None):
+    """Before-iteration callback: SIGKILL this process when boosting
+    iteration ``at_iteration`` begins on ``rank`` (None = any rank) —
+    the preempted-worker failure, a hard death no ``finally`` softens.
+    The surviving ranks block in that round's collective until the
+    watchdog aborts them (parallel/watchdog.py)."""
+    import signal
+
+    def cb(env):
+        if env.iteration >= int(at_iteration) and _rank_matches(rank):
+            os.kill(os.getpid(), signal.SIGKILL)
+    cb.before_iteration = True
+    cb.order = -99
+    return cb
+
+
+def hang_rank(at_iteration: int, rank: Optional[int] = None,
+              hang_s: float = 3600.0):
+    """Before-iteration callback: boosting iteration ``at_iteration`` on
+    ``rank`` blocks for ``hang_s`` (or until the callback's ``release``
+    event is set) — the alive-but-wedged host whose heartbeats keep
+    flowing while its collectives never arrive; the peers' round
+    deadline is what must trip."""
+    release = threading.Event()
+
+    def cb(env):
+        if env.iteration == int(at_iteration) and _rank_matches(rank):
+            release.wait(float(hang_s))
+    cb.before_iteration = True
+    cb.order = -99
+    cb.release = release
+    return cb
+
+
+def delay_rank(at_iteration: int, delay_s: float, times: int = 1,
+               rank: Optional[int] = None):
+    """Before-iteration callback: add ``delay_s`` of latency to
+    ``times`` iterations starting at ``at_iteration`` on ``rank`` — the
+    straggler.  Results stay correct; only time is poisoned (the
+    per-rank ``comm_seconds`` histograms are what makes it visible)."""
+    fired = [0]
+
+    def cb(env):
+        if env.iteration >= int(at_iteration) and fired[0] < int(times) \
+                and _rank_matches(rank):
+            fired[0] += 1
+            time.sleep(float(delay_s))
+    cb.before_iteration = True
+    cb.order = -99
+    cb.fired = fired
+    return cb
+
+
+def corrupt_rank_state(at_iteration: int, rank: Optional[int] = None,
+                       field: str = "score", scale: float = 2.0):
+    """After-iteration callback: silently corrupt ONE rank's replicated
+    training state after iteration ``at_iteration`` completes — the
+    desync failure ``distributed_consistency_check`` exists to catch
+    (a flipped HBM bit, a diverged rematerialization).  ``field``:
+
+    - ``"score"``: add ``scale`` to one element of the train score cache
+      (poisons every later gradient on that rank);
+    - ``"tree"``: scale the newest tree's leaf values (poisons the
+      model itself).
+    """
+    if field not in ("score", "tree"):
+        raise ValueError(f"corrupt_rank_state: unknown field {field!r}")
+    fired = [False]
+
+    def cb(env):
+        if fired[0] or env.iteration < int(at_iteration) \
+                or not _rank_matches(rank):
+            return
+        fired[0] = True
+        gb = getattr(env.model, "_booster", env.model)
+        if field == "score":
+            gb.train_data.score = gb.train_data.score.at[0, 0].add(
+                float(scale))
+        else:
+            gb._flush_pending()
+            if gb._models:
+                tree = gb._models[-1]
+                tree.leaf_value = tree.leaf_value * float(scale)
+    cb.order = 99
+    cb.fired = fired
+    return cb
 
 
 # ---------------------------------------------------------------------------

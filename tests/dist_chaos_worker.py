@@ -1,0 +1,148 @@
+"""Worker for tests/test_dist_chaos.py: one of two cooperating local
+processes exercising the DISTRIBUTED fault-tolerance story end-to-end
+over a real ``jax.distributed`` + gloo runtime (the multiproc_worker.py
+pattern).  Three scenarios, selected by argv:
+
+- ``kill``:   train with per-round snapshots while rank 1 is SIGKILLed
+              mid-run (``faults.kill_rank``); rank 0 must be aborted by
+              the collective watchdog within ``collective_timeout_s``
+              and exit with ``DISTRIBUTED_ABORT_EXIT_CODE`` — reaching
+              the end of this scenario is the FAILURE;
+- ``resume``: a restarted pod agrees on the newest common snapshot via
+              the cross-rank consensus, resumes, and the final model
+              bit-matches an uninterrupted run trained in-process;
+- ``desync``: ``corrupt_rank_state`` on rank 1 is detected by the
+              ``distributed_consistency_check`` digest allgather —
+              ``resync`` heals back to the uncorrupted trajectory
+              (bit-match), ``fail_fast`` stops every rank with a
+              diagnostic naming the diverged rank and field.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np  # noqa: E402
+
+ROUNDS = 8
+KILL_AT = 3          # rank 1 dies entering this boosting iteration
+CORRUPT_AT = 2       # rank 1's score cache is poisoned after this one
+
+
+def main() -> None:
+    scenario, mlist, workdir = sys.argv[1], sys.argv[2], sys.argv[3]
+    rank = int(os.environ["LIGHTGBM_TPU_PROCESS_ID"])
+
+    from lightgbm_tpu import Dataset, LightGBMError
+    from lightgbm_tpu import train as lgb_train
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.parallel.multihost import maybe_initialize_distributed
+    from lightgbm_tpu.testing import faults
+
+    DIST = {"objective": "binary", "metric": ["binary_logloss"],
+            "num_leaves": 6, "max_bin": 32, "min_data_in_leaf": 10,
+            "feature_fraction": 0.8, "learning_rate": 0.2,
+            "tree_learner": "data", "num_machines": 2,
+            "machine_list_file": mlist,
+            "distributed_heartbeat_ms": 100.0,
+            "collective_timeout_s": 8.0}
+    assert maybe_initialize_distributed(Config(DIST)), \
+        "distributed bring-up did not run"
+    assert jax.process_count() == 2, jax.process_count()
+
+    def dataset():
+        rng = np.random.RandomState(9)
+        X = rng.normal(size=(400, 6))
+        y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+             + 0.1 * rng.normal(size=400) > 0).astype(np.float64)
+        return Dataset(X, label=y)
+
+    def model_string(bst):
+        return bst._booster.save_model_to_string()
+
+    snap_dir = os.path.join(workdir, "snaps")
+    verdict_path = os.path.join(workdir, f"verdict_{scenario}_{rank}.txt")
+
+    def verdict(tag, model):
+        with open(verdict_path, "w") as fh:
+            fh.write(tag + "\n")
+            fh.write(model)
+
+    if scenario == "kill":
+        from lightgbm_tpu.parallel.watchdog import (
+            DISTRIBUTED_ABORT_EXIT_CODE, DistributedAborted)
+        params = dict(DIST, snapshot_dir=snap_dir, snapshot_freq=1,
+                      num_iterations=ROUNDS)
+        try:
+            lgb_train(params, dataset(), verbose_eval=False,
+                      callbacks=[faults.kill_rank(KILL_AT, rank=1)])
+        except DistributedAborted as e:
+            # cooperative trip (phase-entry check): same launcher
+            # contract as the watchdog's hard abort — and os._exit for
+            # the same reason, the dead-peer jax shutdown would SIGABRT
+            print(f"worker abort: {e}", flush=True)
+            sys.stderr.flush()
+            os._exit(DISTRIBUTED_ABORT_EXIT_CODE)
+        # rank 1 was SIGKILLed before this point; rank 0 blocks in (or
+        # errors out of) the orphaned collective until the watchdog
+        # aborts it with DISTRIBUTED_ABORT_EXIT_CODE.  Returning here
+        # means the watchdog failed — make that loud and distinct.
+        print("UNEXPECTED_COMPLETION", flush=True)
+        sys.exit(1)
+
+    elif scenario == "resume":
+        from lightgbm_tpu.snapshot import coordinated_resume
+        found = coordinated_resume(snap_dir)
+        assert found is not None, "no coordinated snapshot to resume from"
+        _, state = found
+        assert int(state["rounds_done"]) == KILL_AT, state["rounds_done"]
+        assert int(state["world"]["num_processes"]) == 2
+        params = dict(DIST, snapshot_dir=snap_dir, snapshot_freq=1,
+                      num_iterations=ROUNDS)
+        resumed = lgb_train(params, dataset(), verbose_eval=False)
+        ref = lgb_train(dict(DIST, num_iterations=ROUNDS), dataset(),
+                        verbose_eval=False)
+        assert model_string(resumed) == model_string(ref), \
+            "resumed model does not bit-match the uninterrupted run"
+        verdict("RESUME_OK", model_string(resumed))
+
+    elif scenario == "desync":
+        base = dict(DIST, num_iterations=6, distributed_consistency_check=1)
+        healed = lgb_train(
+            dict(base, desync_policy="resync"), dataset(),
+            verbose_eval=False,
+            callbacks=[faults.corrupt_rank_state(CORRUPT_AT, rank=1,
+                                                 field="score")])
+        ref = lgb_train(dict(DIST, num_iterations=6), dataset(),
+                        verbose_eval=False)
+        assert model_string(healed) == model_string(ref), \
+            "resync did not converge back to the uncorrupted trajectory"
+        # fail_fast: the allgather is symmetric, so EVERY rank stops
+        # together with the named diagnostic
+        try:
+            lgb_train(
+                dict(base, desync_policy="fail_fast"), dataset(),
+                verbose_eval=False,
+                callbacks=[faults.corrupt_rank_state(CORRUPT_AT, rank=1,
+                                                     field="score")])
+            raise AssertionError("fail_fast did not trip on a desync")
+        except LightGBMError as e:
+            msg = str(e)
+            assert "desync" in msg, msg
+            assert "rank(s) [1]" in msg, msg
+            assert "'score'" in msg, msg
+        verdict("DESYNC_OK", model_string(healed))
+
+    else:
+        raise SystemExit(f"unknown scenario {scenario!r}")
+
+
+if __name__ == "__main__":
+    main()

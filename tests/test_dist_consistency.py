@@ -1,0 +1,274 @@
+"""Desync detection (models/gbdt.py `distributed_consistency_check`):
+digest determinism, the fail_fast/resync policies against simulated
+multi-rank gathers, the zero-overhead single-process contract
+(compile-ledger pinned), and the rank stamp on event-stream records.
+The real 2-process detection path is pinned by tests/test_dist_chaos.py."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import Dataset, LightGBMError, obs
+from lightgbm_tpu import train as lgb_train
+from lightgbm_tpu.obs import compile_ledger
+
+pytestmark = pytest.mark.faults
+
+PARAMS = {"objective": "binary", "metric": ["binary_logloss"],
+          "num_leaves": 5, "min_data_in_leaf": 5, "max_bin": 31,
+          "learning_rate": 0.2, "verbose": -1}
+
+
+def _data(seed=11, n=160, f=4):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] - 0.5 * X[:, 1] + 0.3 * rng.normal(size=n) > 0)
+    return X, y.astype(np.float64)
+
+
+def _train(params, rounds=4, callbacks=None):
+    X, y = _data()
+    return lgb_train(dict(PARAMS, **params), Dataset(X, label=y),
+                     num_boost_round=rounds, callbacks=callbacks,
+                     verbose_eval=False)
+
+
+def _fake_world(monkeypatch, rank, world):
+    import lightgbm_tpu.parallel.multihost as mh
+    monkeypatch.setattr(mh, "process_rank_world", lambda: (rank, world))
+
+
+# ---------------------------------------------------------------------------
+# single-process contract: the gate short-circuits before jax
+
+
+def test_single_process_pays_zero_overhead():
+    # warm the shared programs so the pinned run's ledger delta is honest
+    base = _train({})
+    before = len(compile_ledger.events())
+    desync_before = obs.get_counter("desync_detected_total")
+    checked = _train({"distributed_consistency_check": 2})
+    # no new compiles, no detections — K>0 in a 1-process run is free
+    assert len(compile_ledger.events()) == before
+    assert obs.get_counter("desync_detected_total") == desync_before
+    assert (base._booster.save_model_to_string()
+            == checked._booster.save_model_to_string())
+
+
+# ---------------------------------------------------------------------------
+# digest semantics
+
+
+def test_consistency_digests_deterministic_and_field_sensitive():
+    a = _train({})._booster
+    b = _train({})._booster
+    da, db = a._consistency_digests(), b._consistency_digests()
+    assert list(da) == ["iter", "trees", "score", "rng"]
+    assert da == db                     # identical runs, identical digests
+    # perturb ONE replicated field: exactly that digest moves
+    a.train_data.score = a.train_data.score.at[0, 0].add(1.0)
+    dc = a._consistency_digests()
+    assert dc["score"] != da["score"]
+    assert dc["trees"] == da["trees"]
+    assert dc["rng"] == da["rng"]
+    assert dc["iter"] == da["iter"]
+
+
+# ---------------------------------------------------------------------------
+# policies against simulated 2-rank gathers
+
+
+def _divergent_allgather(monkeypatch, field_index, times=1):
+    """Patch the host allgather: rank 1's digest for one field differs
+    on the first ``times`` calls, then the pod looks consistent."""
+    import lightgbm_tpu.parallel.comm as comm
+    calls = []
+
+    def fake(x):
+        x = np.asarray(x)
+        g = np.stack([x, x.copy()])
+        calls.append(g)
+        if len(calls) <= times:
+            g[1, field_index] ^= np.uint64(1)
+        return g
+
+    monkeypatch.setattr(comm, "allgather_host_array", fake)
+    return calls
+
+
+def test_fail_fast_names_rank_and_field(monkeypatch):
+    _fake_world(monkeypatch, 0, 2)
+    calls = _divergent_allgather(monkeypatch, field_index=2, times=99)
+    before = obs.get_counter("desync_detected_total")
+    with pytest.raises(LightGBMError) as ei:
+        _train({"distributed_consistency_check": 2,
+                "desync_policy": "fail_fast"})
+    msg = str(ei.value)
+    assert "desync" in msg
+    assert "'score'" in msg             # names the diverged field...
+    assert "rank(s) [1]" in msg         # ...and the diverged rank
+    assert obs.get_counter("desync_detected_total") == before + 1
+    assert len(calls) == 1              # died at the first divergent check
+
+
+def test_resync_rank0_continues_with_own_state(monkeypatch):
+    _fake_world(monkeypatch, 0, 2)
+    _divergent_allgather(monkeypatch, field_index=2, times=1)
+    import lightgbm_tpu.parallel.comm as comm
+    broadcasts = []
+
+    def fake_broadcast(payload, is_source):
+        assert is_source               # rank 0 is the resync source
+        broadcasts.append(len(payload))
+        return payload
+
+    monkeypatch.setattr(comm, "broadcast_host_bytes", fake_broadcast)
+    before = obs.get_counter("desync_resyncs_total")
+    bst = _train({"distributed_consistency_check": 2,
+                  "desync_policy": "resync"})
+    ref = _train({})
+    assert broadcasts                   # the resync really broadcast
+    assert obs.get_counter("desync_resyncs_total") == before + 1
+    # rank 0 IS the source of truth: its trajectory is untouched
+    assert (bst._booster.save_model_to_string()
+            == ref._booster.save_model_to_string())
+
+
+def test_resync_nonzero_rank_restores_broadcast_state(monkeypatch):
+    _fake_world(monkeypatch, 1, 2)
+    _divergent_allgather(monkeypatch, field_index=2, times=1)
+    import lightgbm_tpu.parallel.comm as comm
+    restored = []
+    orig_restore = None
+
+    def fake_broadcast(payload, is_source):
+        assert not is_source           # rank 1 receives
+        # stand in for rank 0: serve this rank's own (clean) state back,
+        # which must restore as an identity round-trip
+        return pickle.dumps(holder[0].snapshot_state(),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+
+    monkeypatch.setattr(comm, "broadcast_host_bytes", fake_broadcast)
+
+    holder = []
+
+    def grab(env):
+        if not holder:
+            holder.append(env.model._booster)
+            nonlocal orig_restore
+            orig_restore = holder[0].restore_state
+
+            def counting_restore(state):
+                restored.append(int(state["iter_"]))
+                return orig_restore(state)
+            holder[0].restore_state = counting_restore
+    grab.before_iteration = True
+    grab.order = -50
+
+    bst = _train({"distributed_consistency_check": 2,
+                  "desync_policy": "resync"}, callbacks=[grab])
+    ref = _train({})
+    assert restored == [2]              # restore ran, at the check point
+    assert (bst._booster.save_model_to_string()
+            == ref._booster.save_model_to_string())
+
+
+def test_resync_refuses_when_rank0_is_the_diverged_one(monkeypatch):
+    # 3-process pod, majority votes rank 0 the bad one: broadcasting
+    # rank 0's state would propagate the corruption — must fail instead
+    _fake_world(monkeypatch, 0, 3)
+    import lightgbm_tpu.parallel.comm as comm
+
+    def fake(x):
+        x = np.asarray(x)
+        g = np.stack([x, x.copy(), x.copy()])
+        g[0, 2] ^= np.uint64(1)         # rank 0's 'score' digest is odd
+        return g
+    monkeypatch.setattr(comm, "allgather_host_array", fake)
+    with pytest.raises(LightGBMError) as ei:
+        _train({"distributed_consistency_check": 2,
+                "desync_policy": "resync"})
+    msg = str(ei.value)
+    assert "rank 0" in msg and "refusing" in msg
+
+
+def test_broadcast_host_bytes_round_trips_odd_lengths():
+    from lightgbm_tpu.parallel.comm import broadcast_host_bytes
+    payload = b"\x00\x01hello desync resync payload!\xff" * 3 + b"x"
+    assert len(payload) % 4 != 0        # exercise the word padding
+    assert broadcast_host_bytes(payload, is_source=True) == payload
+
+
+# ---------------------------------------------------------------------------
+# rank-level injector mechanics (testing/faults.py) — the kill path is
+# exercised for real by tests/test_dist_chaos.py; here: rank gating,
+# the straggler delay, the hang release valve, and tree corruption
+
+
+def test_rank_injectors_gate_on_rank_and_fire_in_order():
+    import time as _time
+    import types
+
+    from lightgbm_tpu.testing import faults
+    env = types.SimpleNamespace(iteration=2, model=None)
+    # wrong rank: pure no-op (this single process is rank 0)
+    wrong = faults.delay_rank(2, delay_s=30.0, rank=7)
+    t0 = _time.perf_counter()
+    wrong(env)
+    assert _time.perf_counter() - t0 < 1.0
+    assert wrong.fired[0] == 0
+    # kill_rank on another rank must also be inert
+    faults.kill_rank(2, rank=7)(env)
+    # matching rank: delay fires exactly `times` times
+    slow = faults.delay_rank(2, delay_s=0.01, times=2, rank=0)
+    for it in (1, 2, 3, 4):
+        slow(types.SimpleNamespace(iteration=it, model=None))
+    assert slow.fired[0] == 2
+    # hang_rank blocks on its release valve; a pre-set valve = no hang
+    hung = faults.hang_rank(2, rank=0, hang_s=30.0)
+    hung.release.set()
+    t0 = _time.perf_counter()
+    hung(env)
+    assert _time.perf_counter() - t0 < 1.0
+
+
+def test_corrupt_rank_state_tree_field_moves_only_tree_digest():
+    import types
+
+    from lightgbm_tpu.testing import faults
+    bst = _train({})
+    gb = bst._booster
+    before = gb._consistency_digests()
+    cb = faults.corrupt_rank_state(1, rank=0, field="tree", scale=3.0)
+    cb(types.SimpleNamespace(iteration=1, model=bst))
+    after = gb._consistency_digests()
+    assert cb.fired[0]
+    assert after["trees"] != before["trees"]
+    assert after["score"] == before["score"]
+    assert after["rng"] == before["rng"]
+
+
+# ---------------------------------------------------------------------------
+# event-stream rank stamping (obs/events.py)
+
+
+def test_event_records_carry_rank_under_multihost(monkeypatch, tmp_path):
+    _fake_world(monkeypatch, 3, 4)
+    rec = obs.EventRecorder(str(tmp_path / "events.jsonl"))
+    # the path is suffixed per rank: N ranks sharing one conf would
+    # otherwise truncate each other's streams
+    assert rec.path == str(tmp_path / "events.rank3.jsonl")
+    rec.note(0, wall_s=0.1)
+    rec.note(1, wall_s=0.2)
+    rec.close()
+    evs = obs.read_events(rec.path)
+    assert [e["rank"] for e in evs] == [3, 3]
+
+
+def test_event_records_plain_single_process(tmp_path):
+    path = tmp_path / "events.jsonl"
+    rec = obs.EventRecorder(str(path))
+    rec.note(0, wall_s=0.1)
+    rec.close()
+    assert "rank" not in obs.read_events(str(path))[0]

@@ -23,11 +23,44 @@ def test_parse_machine_list(tmp_path):
         ("10.0.0.1", 12400), ("10.0.0.2", 12401), ("worker-3", 12402)]
 
 
-def test_parse_machine_list_malformed(tmp_path):
+def test_parse_machine_list_malformed_names_file_and_line(tmp_path):
     p = tmp_path / "mlist.txt"
-    p.write_text("10.0.0.1\n")
-    with pytest.raises(LightGBMError):
+    p.write_text("# header\n10.0.0.1 12400\n10.0.0.1\n")
+    with pytest.raises(LightGBMError) as ei:
         parse_machine_list(str(p))
+    assert str(p) in str(ei.value)
+    assert "line 3" in str(ei.value)
+
+
+def test_parse_machine_list_bad_port_names_file_and_line(tmp_path):
+    p = tmp_path / "mlist.txt"
+    p.write_text("10.0.0.1 http\n")
+    with pytest.raises(LightGBMError) as ei:
+        parse_machine_list(str(p))
+    assert str(p) in str(ei.value)
+    assert "line 1" in str(ei.value)
+
+
+def test_parse_machine_list_rejects_duplicates_at_parse_time(tmp_path):
+    # a duplicated line used to fall through to find_process_id's
+    # confusing "matches this host N times"; it must die HERE, named
+    p = tmp_path / "mlist.txt"
+    p.write_text("10.0.0.1 12400\n10.0.0.2 12400\n10.0.0.1 12400\n")
+    with pytest.raises(LightGBMError) as ei:
+        parse_machine_list(str(p))
+    msg = str(ei.value)
+    assert str(p) in msg
+    assert "line 3" in msg and "line 1" in msg
+    assert "10.0.0.1 12400" in msg
+
+
+def test_parse_machine_list_same_host_distinct_ports_ok(tmp_path):
+    # several processes per machine (same IP, different ports) is a
+    # legitimate layout — only exact (host, port) repeats are fatal
+    p = tmp_path / "mlist.txt"
+    p.write_text("10.0.0.1 12400\n10.0.0.1 12401\n")
+    assert parse_machine_list(str(p)) == [
+        ("10.0.0.1", 12400), ("10.0.0.1", 12401)]
 
 
 def test_find_process_id_env_override(monkeypatch):

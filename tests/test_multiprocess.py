@@ -8,6 +8,7 @@ service, cross-process collectives, and exact parity with serial
 training (asserted inside each worker — see multiproc_worker.py)."""
 
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -20,6 +21,20 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def kill_worker_tree(proc: subprocess.Popen) -> None:
+    """SIGKILL a worker's whole process group (it was started with
+    ``start_new_session=True``): a wedged distributed worker can hold
+    grandchildren/threads that survive a bare ``proc.kill`` and burn the
+    rest of the tier-1 budget waiting on inherited pipes."""
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            proc.kill()
+        except OSError:
+            pass
 
 
 def _launch_once(tmp_path, attempt):
@@ -40,7 +55,7 @@ def _launch_once(tmp_path, attempt):
             [sys.executable, os.path.join(HERE, "multiproc_worker.py"),
              str(mlist), str(out)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True))
+            text=True, start_new_session=True))
 
     logs = []
     rcs = []
@@ -48,7 +63,7 @@ def _launch_once(tmp_path, attempt):
         try:
             stdout, _ = p.communicate(timeout=600)
         except subprocess.TimeoutExpired:
-            p.kill()
+            kill_worker_tree(p)
             stdout, _ = p.communicate()
             stdout += "\n<<TIMEOUT>>"
         logs.append(stdout)

@@ -1,0 +1,186 @@
+"""Coordinated snapshots under multihost (snapshot.py): the rank-0-writes
+discipline (no concurrent-writer races into one snapshot_dir), the
+``world`` block, the corrupt-file skip accounting, and the cross-rank
+resume consensus — simulated ranks here; the real 2-process path is
+pinned by tests/test_dist_chaos.py."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import Dataset, LightGBMError, obs
+from lightgbm_tpu import train as lgb_train
+from lightgbm_tpu.snapshot import (coordinated_resume, is_snapshot_writer,
+                                   list_snapshots, load_latest_snapshot,
+                                   read_snapshot, replicated_state_digest,
+                                   snapshot_path)
+from lightgbm_tpu.testing import faults
+
+pytestmark = pytest.mark.faults
+
+PARAMS = {"objective": "binary", "metric": ["binary_logloss"],
+          "num_leaves": 5, "min_data_in_leaf": 5, "max_bin": 31,
+          "verbose": -1}
+
+
+def _train(rounds=3):
+    rng = np.random.RandomState(5)
+    X = rng.normal(size=(150, 4))
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(np.float64)
+    return lgb_train(dict(PARAMS), Dataset(X, label=y),
+                     num_boost_round=rounds, verbose_eval=False)
+
+
+def _fake_world(monkeypatch, rank, world):
+    import lightgbm_tpu.parallel.multihost as mh
+    monkeypatch.setattr(mh, "process_rank_world", lambda: (rank, world))
+
+
+def _canned_allgather(monkeypatch, responses):
+    """Serve scripted [world, ...] gathers in call order; later calls
+    echo (all ranks agree with this one)."""
+    import lightgbm_tpu.parallel.comm as comm
+    canned = list(responses)
+
+    def fake(x):
+        if canned:
+            return np.asarray(canned.pop(0))
+        x = np.asarray(x)
+        return np.stack([x, x])
+    monkeypatch.setattr(comm, "allgather_host_array", fake)
+
+
+# ---------------------------------------------------------------------------
+# rank-0-writes discipline
+
+
+def test_non_zero_rank_never_writes(monkeypatch, tmp_path):
+    bst = _train()
+    _fake_world(monkeypatch, 1, 2)
+    assert not is_snapshot_writer()
+    assert bst.save_snapshot(str(tmp_path)) is None
+    # nothing raced into the directory: no snapshot, no torn temp file
+    assert os.listdir(tmp_path) == []
+
+
+def test_non_zero_rank_skips_even_a_torn_write(monkeypatch, tmp_path):
+    # the discipline gates BEFORE the file layer: a write that would
+    # have torn never even creates the .tmp a concurrent prune could eat
+    bst = _train()
+    _fake_world(monkeypatch, 0, 2)
+    first = bst.save_snapshot(str(tmp_path))
+    assert first and read_snapshot(first) is not None
+    _fake_world(monkeypatch, 1, 2)
+    with faults.torn_snapshot_write(after_bytes=16) as stats:
+        assert bst.save_snapshot(str(tmp_path), rounds_done=9) is None
+    assert stats["torn"] == []
+    assert glob.glob(str(tmp_path / "*.tmp")) == []
+    # and the rank-0 file is untouched
+    assert [p for _, p in list_snapshots(str(tmp_path))] == [first]
+
+
+def test_rank0_torn_write_falls_back_to_previous(monkeypatch, tmp_path):
+    bst = _train()
+    _fake_world(monkeypatch, 0, 2)
+    first = bst.save_snapshot(str(tmp_path))
+    with faults.torn_snapshot_write(after_bytes=16):
+        with pytest.raises(faults.InjectedCrash):
+            bst.save_snapshot(str(tmp_path), rounds_done=9)
+    found = load_latest_snapshot(str(tmp_path))
+    assert found is not None and found[0] == first
+
+
+def test_world_block_recorded(monkeypatch, tmp_path):
+    bst = _train()
+    _fake_world(monkeypatch, 0, 2)
+    path = bst.save_snapshot(str(tmp_path))
+    state = read_snapshot(path)
+    w = state["world"]
+    assert w["num_processes"] == 2 and w["rank"] == 0
+    assert len(w["digest"]) == 64
+    # the digest is the desync detector's field fingerprint, cheap and
+    # reproducible from the live state for cross-rank log comparison
+    assert w["digest"] == replicated_state_digest(bst._booster)
+
+
+# ---------------------------------------------------------------------------
+# corrupt-file skip accounting
+
+
+def test_corrupt_skip_counts_and_names_file(tmp_path, capfd):
+    bst = _train()
+    good = bst.save_snapshot(str(tmp_path), rounds_done=2)
+    bad = bst.save_snapshot(str(tmp_path), rounds_done=3)
+    faults.flip_byte(bad)
+    before = obs.get_counter("snapshot_corrupt_skipped_total")
+    found = load_latest_snapshot(str(tmp_path))
+    assert found is not None and found[0] == good
+    assert obs.get_counter("snapshot_corrupt_skipped_total") == before + 1
+    assert os.path.basename(bad) in capfd.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# resume consensus (simulated 2-rank gathers)
+
+
+def _snapshot_dir(tmp_path, monkeypatch, rounds=(2, 3)):
+    bst = _train()
+    _fake_world(monkeypatch, 0, 2)
+    for r in rounds:
+        bst.save_snapshot(str(tmp_path), rounds_done=r)
+    return bst
+
+
+def test_consensus_agreement(monkeypatch, tmp_path):
+    _snapshot_dir(tmp_path, monkeypatch)
+    _canned_allgather(monkeypatch, [np.int64([3, 3])])
+    path, state = coordinated_resume(str(tmp_path))
+    assert path == snapshot_path(str(tmp_path), 3)
+    assert state["rounds_done"] == 3
+
+
+def test_consensus_takes_minimum_common_iteration(monkeypatch, tmp_path):
+    # the other rank's disk only replicated up to round 2: the pod must
+    # agree on 2, not this rank's newer 3
+    _snapshot_dir(tmp_path, monkeypatch)
+    _canned_allgather(monkeypatch, [np.int64([3, 2])])
+    path, state = coordinated_resume(str(tmp_path))
+    assert state["rounds_done"] == 2
+    assert path == snapshot_path(str(tmp_path), 2)
+
+
+def test_consensus_fresh_start_when_any_rank_has_none(monkeypatch,
+                                                      tmp_path, capfd):
+    _snapshot_dir(tmp_path, monkeypatch)
+    _canned_allgather(monkeypatch, [np.int64([3, -1])])
+    assert coordinated_resume(str(tmp_path)) is None
+    assert "starts FRESH" in capfd.readouterr().err
+
+
+def test_consensus_refuses_diverged_replicas(monkeypatch, tmp_path):
+    _snapshot_dir(tmp_path, monkeypatch)
+    _canned_allgather(monkeypatch, [
+        np.int64([3, 3]),
+        np.uint64([1, 2]),           # ranks loaded different bytes
+    ])
+    with pytest.raises(LightGBMError, match="differs across ranks"):
+        coordinated_resume(str(tmp_path))
+
+
+def test_consensus_refuses_world_size_mismatch(monkeypatch, tmp_path):
+    bst = _train()
+    _fake_world(monkeypatch, 0, 4)      # written by a 4-process pod
+    bst.save_snapshot(str(tmp_path), rounds_done=2)
+    _fake_world(monkeypatch, 0, 2)      # restarted with 2
+    _canned_allgather(monkeypatch, [np.int64([2, 2])])
+    with pytest.raises(LightGBMError, match="4-process"):
+        coordinated_resume(str(tmp_path))
+
+
+def test_consensus_single_process_is_plain_load(tmp_path):
+    bst = _train()
+    path = bst.save_snapshot(str(tmp_path))
+    found = coordinated_resume(str(tmp_path))
+    assert found is not None and found[0] == path

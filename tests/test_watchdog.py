@@ -1,0 +1,244 @@
+"""Collective watchdog unit tests (parallel/watchdog.py): the heartbeat
+mesh, the staleness/deadline trips, and the abort machinery — all
+in-process (two meshes on localhost stand in for two ranks; the real
+2-process path is the ``dist_chaos`` suite)."""
+
+import socket
+import time
+
+import pytest
+
+from lightgbm_tpu.parallel.watchdog import (DISTRIBUTED_ABORT_EXIT_CODE,
+                                            CollectiveWatchdog,
+                                            DistributedAborted,
+                                            HeartbeatMesh)
+
+pytestmark = pytest.mark.faults
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _machines(ports):
+    return [("127.0.0.1", p) for p in ports]
+
+
+def _wait_for(cond, timeout_s=5.0, step=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+class FakeMesh:
+    """Scripted peer ages for deadline-path tests."""
+
+    def __init__(self, ages):
+        self.ages = dict(ages)
+        self.stopped = False
+
+    def peer_ages(self):
+        return dict(self.ages)
+
+    def stop(self):
+        self.stopped = True
+
+
+def test_heartbeat_mesh_sees_live_peer_and_ages_dead_one():
+    machines = _machines(_free_ports(2))
+    m0 = HeartbeatMesh(machines, 0, interval_s=0.05)
+    m1 = HeartbeatMesh(machines, 1, interval_s=0.05)
+    try:
+        # both directions converge to fresh heartbeats
+        assert _wait_for(lambda: m0.peer_ages().get(1, 99) < 0.5)
+        assert _wait_for(lambda: m1.peer_ages().get(0, 99) < 0.5)
+        # kill rank 1: its age at rank 0 grows monotonically
+        m1.stop()
+        time.sleep(0.4)
+        assert m0.peer_ages()[1] > 0.3
+    finally:
+        m0.stop()
+        m1.stop()
+
+
+def test_cooperative_check_raises_named_distributed_aborted():
+    machines = _machines(_free_ports(2))
+    m0 = HeartbeatMesh(machines, 0, interval_s=0.05)
+    m1 = HeartbeatMesh(machines, 1, interval_s=0.05)
+    wd = CollectiveWatchdog(0, 2, mesh=m0, heartbeat_s=0.05,
+                            timeout_s=0.4, abort_fn=lambda code: None)
+    try:
+        # rank 1 was heard, then died: staleness is real evidence
+        assert _wait_for(lambda: m0.peer_ages().get(1, 99) < 0.5)
+        m1.stop()
+        assert _wait_for(lambda: bool(wd.stale_peers()), timeout_s=3.0)
+        with pytest.raises(DistributedAborted) as ei:
+            wd.check("Comm::grow")
+        err = ei.value
+        assert err.rank == 1
+        assert err.phase == "Comm::grow"
+        assert err.last_seen > 0.3
+        assert "rank 1" in str(err)
+        # phase entry runs the same cooperative check
+        with pytest.raises(DistributedAborted):
+            with wd.phase("Comm::grow"):
+                pass
+    finally:
+        wd.stop()
+        m1.stop()
+
+
+def test_never_heard_peers_degrade_instead_of_aborting():
+    # no peer process ever existed: an undeliverable heartbeat channel
+    # (blocked UDP) must NOT abort a healthy pod — it warns once and
+    # leaves the deadline as the only detector
+    from lightgbm_tpu.utils import log as lgb_log
+    lgb_log.reset_warn_once()
+    machines = _machines(_free_ports(2))
+    m0 = HeartbeatMesh(machines, 0, interval_s=0.05)
+    aborts = []
+    wd = CollectiveWatchdog(0, 2, mesh=m0, heartbeat_s=0.05,
+                            timeout_s=0.3, tick_s=0.05,
+                            abort_fn=aborts.append)
+    try:
+        time.sleep(0.6)
+        assert wd.stale_peers() == []
+        assert m0.unheard_peers() == [1]
+        wd.check("Comm::grow")          # no raise
+        with wd._lock:
+            wd._phase = ["Comm::grow", time.monotonic(), None, False]
+        time.sleep(0.5)
+        assert aborts == []             # no hard abort either
+        assert "watchdog_channel_silent" in lgb_log._warned_once
+    finally:
+        wd.stop()
+
+
+def test_hard_abort_fires_in_phase_on_stale_peer_and_flushes():
+    machines = _machines(_free_ports(2))
+    m0 = HeartbeatMesh(machines, 0, interval_s=0.05)
+    m1 = HeartbeatMesh(machines, 1, interval_s=0.05)
+    aborts = []
+    flushed = []
+    wd = CollectiveWatchdog(0, 2, mesh=m0, heartbeat_s=0.05,
+                            timeout_s=0.5, tick_s=0.05,
+                            abort_fn=aborts.append)
+    wd.register_flush(lambda: flushed.append(True))
+    try:
+        assert _wait_for(lambda: m0.peer_ages().get(1, 99) < 0.5)
+        m1.stop()
+        # out of phase: a stale peer must NOT hard-abort (the next phase
+        # entry raises cooperatively instead)
+        time.sleep(0.8)
+        assert aborts == []
+        # simulate being wedged inside the collective: enter the phase
+        # without the cooperative check (which would raise here)
+        with wd._lock:
+            wd._phase = ["Comm::grow", time.monotonic(), None, False]
+        assert _wait_for(lambda: aborts, timeout_s=3.0)
+        assert aborts[0] == DISTRIBUTED_ABORT_EXIT_CODE
+        assert flushed == [True]
+    finally:
+        wd.stop()
+        m1.stop()
+
+
+def test_guard_classifies_collective_errors_and_passes_own_errors():
+    from lightgbm_tpu.basic import LightGBMError
+    fake = FakeMesh({1: 0.01})
+    aborts = []
+    wd = CollectiveWatchdog(0, 2, mesh=fake, heartbeat_s=0.05,
+                            timeout_s=0.3, tick_s=10.0,
+                            abort_fn=aborts.append)
+    try:
+        # peers alive: a genuine error re-raises after the wait window
+        with pytest.raises(RuntimeError, match="xla exploded"):
+            with wd.guard("Dist::resume"):
+                raise RuntimeError("xla exploded")
+        assert aborts == []
+        # our own diagnostics pass through untouched, no classify wait
+        t0 = time.monotonic()
+        with pytest.raises(LightGBMError, match="deliberate"):
+            with wd.guard("Dist::resume"):
+                raise LightGBMError("deliberate diagnostic")
+        assert time.monotonic() - t0 < 0.2
+        # peer goes silent right as the collective errors: abort path
+        with pytest.raises(RuntimeError):
+            with wd.guard("Dist::resume"):
+                fake.ages[1] = 99.0
+                raise RuntimeError("connection reset by peer")
+        assert aborts == [DISTRIBUTED_ABORT_EXIT_CODE]
+    finally:
+        wd.stop()
+
+
+def test_phase_deadline_trips_without_peer_death():
+    # peers look perfectly alive; only the round deadline expires
+    wd = CollectiveWatchdog(0, 2, mesh=FakeMesh({1: 0.01}),
+                            heartbeat_s=0.05, timeout_s=0.3, tick_s=0.05,
+                            abort_fn=lambda code: None)
+    aborts = []
+    wd._abort_fn = aborts.append
+    try:
+        with wd._lock:
+            wd._phase = ["Comm::grow", time.monotonic(),
+                         time.monotonic() + 0.2, False]
+        assert _wait_for(lambda: aborts, timeout_s=3.0)
+    finally:
+        wd.stop()
+
+
+def test_effective_timeout_policy():
+    wd = CollectiveWatchdog(0, 2, mesh=None, heartbeat_s=0.5,
+                            timeout_s=0.0, abort_fn=lambda code: None)
+    try:
+        # auto mode floors at 60s and, before any EWMA sample, sets NO
+        # per-phase deadline (round 1 includes its XLA compile)
+        assert wd.effective_timeout() == pytest.approx(60.0)
+        assert wd._phase_deadline() is None
+        # the EWMA can only RAISE the bound, never tighten under the floor
+        wd.note_comm_seconds(0.5)
+        assert wd.effective_timeout() == pytest.approx(60.0)
+        assert wd._phase_deadline() == pytest.approx(60.0)
+        for _ in range(50):
+            wd.note_comm_seconds(30.0)
+        assert wd.effective_timeout() > 60.0
+    finally:
+        wd.stop()
+    # explicit collective_timeout_s wins over everything
+    wd2 = CollectiveWatchdog(0, 2, mesh=None, heartbeat_s=0.5,
+                             timeout_s=7.0, abort_fn=lambda code: None)
+    try:
+        wd2.note_comm_seconds(30.0)
+        assert wd2.effective_timeout() == pytest.approx(7.0)
+        assert wd2._phase_deadline() == pytest.approx(7.0)
+    finally:
+        wd2.stop()
+
+
+def test_abort_is_once_and_counts():
+    from lightgbm_tpu import obs
+    before = obs.get_counter("distributed_aborts_total")
+    aborts = []
+    wd = CollectiveWatchdog(0, 2, mesh=FakeMesh({1: 100.0}),
+                            heartbeat_s=0.05, timeout_s=0.1, tick_s=0.02,
+                            abort_fn=aborts.append)
+    try:
+        with wd._lock:
+            wd._phase = ["Comm::grow", time.monotonic(), None, False]
+        assert _wait_for(lambda: aborts, timeout_s=3.0)
+        time.sleep(0.2)
+        assert len(aborts) == 1          # latched: one abort only
+        assert obs.get_counter("distributed_aborts_total") == before + 1
+    finally:
+        wd.stop()
