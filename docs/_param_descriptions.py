@@ -147,7 +147,48 @@ DESC = {
     "ignore_column": "feature indices to drop",
     "is_predict_raw_score": "predict: output raw scores",
     "is_predict_leaf_index": "predict: output leaf indices",
-    "verbosity": "log level",
+    "verbose": "log level (alias verbosity)",
+    "seed": "master seed; derived seeds cover bagging/feature/dart draws "
+            "unless set explicitly",
+    "num_threads": "host thread hint (accepted for conf compatibility; "
+                   "device parallelism comes from the mesh)",
+    "num_iteration_predict": "predict with only the first K iterations "
+                             "(-1 = all)",
+    "is_pre_partition": "distributed: data files are already partitioned "
+                        "per machine (accepted for conf compatibility)",
+    "is_enable_sparse": "enable sparse-aware histogram optimizations "
+                        "(accepted for conf compatibility; the TPU bin "
+                        "matrix is dense)",
+    "is_save_binary_file": "save the parsed dataset as a binary sidecar "
+                           "for faster reloads",
+    "enable_load_from_binary_file": "load the binary sidecar when present "
+                                    "instead of re-parsing text",
+    "max_conflict_rate": "feature bundling: max share of conflicting rows "
+                         "allowed in one bundle (EFB)",
+    "enable_bundle": "bundle mutually-exclusive sparse features into "
+                     "single columns (EFB)",
+    "weight_column": "per-row weight column index/name in the data file",
+    "group_column": "query/group column index/name (lambdarank)",
+    "histogram_pool_size": "reference histogram cache budget in MB "
+                           "(-1 = unbounded; accepted for conf "
+                           "compatibility — the TPU learner keeps leaf "
+                           "histograms on device)",
+    "local_listen_port": "distributed: first TCP port from the reference "
+                         "machine-list protocol; the coordinator binds "
+                         "entry 0's port, the heartbeat mesh datagrams "
+                         "each rank's own (parallel/multihost.py)",
+    "time_out": "distributed: socket/connect timeout in minutes from the "
+                "reference conf surface (coordinator connects use "
+                "distributed_init_retries/backoff)",
+    "machine_list_file": "distributed: one 'host port' line per rank — "
+                         "numbers the processes, locates the "
+                         "coordinator, and seeds the watchdog heartbeat "
+                         "mesh (docs/FAULT_TOLERANCE.md §Distributed)",
+    "tpu_histogram_impl": "auto | scatter | onehot | pallas — histogram "
+                          "kernel selection (ops/histogram.py; auto "
+                          "picks pallas on TPU, onehot elsewhere)",
+    "tpu_double_hist": "accumulate histograms in float64 (CPU parity "
+                       "tests; TPUs run f32)",
     # fault tolerance (docs/FAULT_TOLERANCE.md)
     "snapshot_dir": "crash-safe snapshot directory; also enables "
                     "auto-resume (multihost: rank 0 writes, resume runs "

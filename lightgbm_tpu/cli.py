@@ -72,7 +72,7 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
         valid_names.append(f"valid_{i + 1}")
 
     num_rounds = config.num_iterations
-    start = time.time()
+    start = time.monotonic()
     evals_result: Dict[str, dict] = {}
     booster = engine_train(
         dict(params), train_set, num_boost_round=num_rounds,
@@ -82,7 +82,8 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
                                if config.early_stopping_round > 0 else None),
         evals_result=evals_result,
         init_model=(config.input_model or None))
-    log.info("%f seconds elapsed, finished training", time.time() - start)
+    log.info("%f seconds elapsed, finished training",
+             time.monotonic() - start)
     out = config.output_model or "LightGBM_model.txt"
     booster.save_model(out)
     log.info("Finished training. Model saved to %s", out)
@@ -118,7 +119,7 @@ def run_predict(config: Config, params: Dict[str, str]) -> None:
     if not config.data:
         log.fatal("No prediction data specified (data=...)")
     booster = Booster(params=dict(params), model_file=config.input_model)
-    start = time.time()
+    start = time.monotonic()
     result_path = config.output_result or "LightGBM_predict_result.txt"
     pred_leaf = config.is_predict_leaf_index
     if not pred_leaf and booster.num_trees() > 0:
@@ -137,7 +138,7 @@ def run_predict(config: Config, params: Dict[str, str]) -> None:
             _write_prediction_rows(fh, part, pred_leaf)
             n_rows += part.shape[0] if pred_leaf else part.shape[-1]
     log.info("%f seconds elapsed, finished prediction of %d rows",
-             time.time() - start, n_rows)
+             time.monotonic() - start, n_rows)
     log.info("Finished prediction. Results saved to %s", result_path)
 
 

@@ -74,7 +74,10 @@ def _build(lib_path: str) -> bool:
     # Build to a temp name and rename into place: the cache dir may be
     # shared, and a killed/concurrent build must never leave a truncated
     # .so at the final path (os.rename is atomic within a filesystem).
-    tmp_path = f"{lib_path}.tmp.{os.getpid()}"
+    # The name carries pid AND thread id: get_lib deliberately lets two
+    # first-caller threads build concurrently, and a pid-only name would
+    # have them clobber each other's in-progress object file.
+    tmp_path = f"{lib_path}.tmp.{os.getpid()}.{threading.get_ident()}"
     cmd = ["g++", *_BUILD_FLAGS, _SRC, "-o", tmp_path]
     try:
         try:
@@ -102,49 +105,64 @@ def _build(lib_path: str) -> bool:
 
 def get_lib() -> Optional[ctypes.CDLL]:
     """The loaded native library, building it on first call; None if
-    unavailable (callers fall back to Python)."""
+    unavailable (callers fall back to Python).
+
+    The g++ build (up to the 180s subprocess timeout) and the dlopen run
+    OUTSIDE ``_lock``: the lock guards only the published ``_lib``/
+    ``_tried`` state, so a second data-loading thread arriving mid-build
+    is never parked behind a 3-minute compile.  Two first-callers may
+    race into ``_load_or_build`` and compile twice — safe (``_build``
+    writes to a temp name and atomically renames) and a one-time startup
+    cost, where serializing behind the lock was a per-thread stall."""
     global _lib, _tried
     with _lock:
         if _lib is not None or _tried:
             return _lib
+    lib = _load_or_build()
+    with _lock:
         _tried = True
-        lib_path = _lib_path()
-        if not os.path.exists(lib_path):
-            if not _build(lib_path):
-                return None
+        if _lib is None and lib is not None:
+            _lib = lib
+        return _lib
+
+
+def _load_or_build() -> Optional[ctypes.CDLL]:
+    lib_path = _lib_path()
+    if not os.path.exists(lib_path):
+        if not _build(lib_path):
+            return None
+    try:
+        lib = ctypes.CDLL(lib_path)
+    except OSError:
+        # A stale/corrupt cached binary (e.g. from an older scheme or a
+        # foreign host): rebuild once before giving up.
+        try:
+            os.unlink(lib_path)
+        except OSError:
+            pass
+        if not _build(lib_path):
+            return None
         try:
             lib = ctypes.CDLL(lib_path)
-        except OSError:
-            # A stale/corrupt cached binary (e.g. from an older scheme or a
-            # foreign host): rebuild once before giving up.
-            try:
-                os.unlink(lib_path)
-            except OSError:
-                pass
-            if not _build(lib_path):
-                return None
-            try:
-                lib = ctypes.CDLL(lib_path)
-            except OSError as e:
-                log.warning("could not load native library: %s", e)
-                return None
-        lib.lgbt_parse_file.restype = ctypes.c_int
-        lib.lgbt_parse_file.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
-            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
-            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
-            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int)]
-        lib.lgbt_free.restype = None
-        lib.lgbt_free.argtypes = [ctypes.c_void_p]
-        lib.lgbt_values_to_bins.restype = None
-        lib.lgbt_values_to_bins.argtypes = [
-            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_double), ctypes.c_int,
-            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint16),
-            ctypes.c_int]
-        _lib = lib
-        return _lib
+        except OSError as e:
+            log.warning("could not load native library: %s", e)
+            return None
+    lib.lgbt_parse_file.restype = ctypes.c_int
+    lib.lgbt_parse_file.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.lgbt_free.restype = None
+    lib.lgbt_free.argtypes = [ctypes.c_void_p]
+    lib.lgbt_values_to_bins.restype = None
+    lib.lgbt_values_to_bins.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint16),
+        ctypes.c_int]
+    return lib
 
 
 _FMT_NAMES = {0: "csv", 1: "tsv", 2: "libsvm"}

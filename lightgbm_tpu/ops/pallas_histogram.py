@@ -46,6 +46,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..obs.compile_ledger import instrumented_jit
 from ..utils.compile_cache import bucket_rows
 from .split import SplitParams, per_feature_scan
 
@@ -179,7 +180,8 @@ def _pad_row_inputs(bins, grad, hess, weight, leaf_id, n_blk: int):
     return bins, grad, hess, weight, leaf_id, N + pad
 
 
-@functools.partial(jax.jit, static_argnames=("max_bin", "n_blk", "interpret"))
+@instrumented_jit(program="pallas_children_hist",
+                  static_argnames=("max_bin", "n_blk", "interpret"))
 def children_histograms_pallas(bins, grad, hess, weight, leaf_id,
                                parent_leaf, right_leaf, max_bin: int,
                                n_blk: int = 2048, interpret: bool = False):
@@ -224,8 +226,9 @@ def children_histograms_pallas(bins, grad, hess, weight, leaf_id,
     return out.transpose(1, 0, 3, 2)[:, :, :max_bin, :]
 
 
-@functools.partial(jax.jit, static_argnames=("max_bin", "params", "n_blk",
-                                             "interpret"))
+@instrumented_jit(program="pallas_fused_gain",
+                  static_argnames=("max_bin", "params", "n_blk",
+                                   "interpret"))
 def fused_children_split_candidates_pallas(
         bins, grad, hess, weight, leaf_id, parent_leaf, right_leaf,
         totals, num_bin, is_cat, feat_mask, max_bin: int,
@@ -281,7 +284,8 @@ def fused_children_split_candidates_pallas(
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("max_bin", "n_blk", "interpret"))
+@instrumented_jit(program="pallas_root_hist",
+                  static_argnames=("max_bin", "n_blk", "interpret"))
 def root_histogram_pallas(bins, grad, hess, weight, max_bin: int,
                           n_blk: int = 2048, interpret: bool = False):
     """[F, B, 3] root histogram: reuse the children kernel with every row

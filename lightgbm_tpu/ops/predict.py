@@ -77,7 +77,12 @@ def predict_binned_tree(split_feature, split_bin, is_cat_node, left_child,
     return leaf_value[leaf], leaf
 
 
-@functools.partial(jax.jit, static_argnames=("max_steps",))
+# ledgered one level up: every offline caller goes through the
+# process-wide CountingJit wrapper (models/gbdt.py _counting_forest_jit,
+# program "predict_forest"); serve/forest.py inlines this jit into its
+# own instrumented programs.  Wrapping here too would double-count each
+# compile in the ledger.
+@functools.partial(jax.jit, static_argnames=("max_steps",))  # graftcheck: disable=jit-raw
 def predict_binned_forest(split_feature, split_bin, is_cat_node, left_child,
                           right_child, leaf_value, bins, max_steps: int):
     """Sum of tree predictions.

@@ -11,13 +11,12 @@ data/voting modes.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
-import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..obs.compile_ledger import instrumented_jit
 from ..ops.grow import GrowParams, _grow_tree_impl
 from ._compat import shard_map
 from .comm import DataParallelComm, FeatureParallelComm, VotingParallelComm
@@ -58,7 +57,12 @@ def make_parallel_grow(mesh: Mesh, mode: str, params: GrowParams,
         in_specs = (P(None, None), P(), P(), P(), P(), P(), P(), P())
         out_specs = (P(), P(), P())
 
-    @functools.partial(jax.jit, static_argnames=())
+    # one program per (mesh, mode, params) factory call — ledgered as
+    # dist_grow_tree so a distributed run's compiles are attributable
+    # like the serial growers' (the factory result is cached per
+    # booster; a second same-config factory still recompiles, which the
+    # ledger now makes visible instead of silent)
+    @instrumented_jit(program="dist_grow_tree")
     def grow(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
              learning_rate):
         F, N = bins.shape

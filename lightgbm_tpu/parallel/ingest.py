@@ -40,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..io.binning import NUMERICAL, BinMapper
 from ..io.dataset import BinnedDataset, Metadata
+from ..obs.compile_ledger import instrumented_jit
 from ..utils import log
 
 
@@ -172,7 +173,7 @@ def make_psum(mesh: Mesh, axis: str):
     3-component f32 transport is exact: no f64 precision is lost even
     though the devices compute in f32 (x64 stays off)."""
 
-    @jax.jit
+    @instrumented_jit(program="dist_psum_exchange")
     def _psum(x_stacked):
         # x_stacked: [k, 3, ...] one contribution per shard
         def body(x):

@@ -816,8 +816,12 @@ class ModelManager:
             with obs.span("Serve::reload"):
                 t0 = time.perf_counter()
                 forest = self._loader(model_path)
-                new_set = self.fleet.promote(forest, target=target,
-                                             model_path=str(model_path))
+                # deliberate: the reload lock exists precisely to hold
+                # one build+warm+swap at a time; nothing on the serving
+                # path ever takes it, so the long warmup stalls only a
+                # competing reload
+                new_set = self.fleet.promote(  # graftcheck: disable=lock-blocking
+                    forest, target=target, model_path=str(model_path))
                 log.info("serve: reload of %s -> generation %d took %.2fs",
                          model_path, new_set.generation,
                          time.perf_counter() - t0)

@@ -245,7 +245,9 @@ class CompiledForest:
             raw = self._walk(tree_dev, bins)
             raw = jnp.where(mask[None, :], raw, 0.0)
             return raw
-        return jax.jit(binned_fn)
+        # ledgered by the CountingJit wrapper built right above in
+        # from_booster/to_device (program "predict_forest")
+        return jax.jit(binned_fn)  # graftcheck: disable=jit-raw
 
     def _make_raw_fn(self):
         import jax
@@ -274,7 +276,9 @@ class CompiledForest:
             out = self._transform(raw)
             out = jnp.where(mask[None, :], out, 0.0)
             return raw, out
-        return jax.jit(raw_fn)
+        # ledgered by the CountingJit wrapper built right above
+        # (program "serve_forest")
+        return jax.jit(raw_fn)  # graftcheck: disable=jit-raw
 
     # ------------------------------------------------------------------
     # host-side exact binning (f64 compares, identical routing to the

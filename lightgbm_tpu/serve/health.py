@@ -220,7 +220,11 @@ class Watchdog:
         replica hangs the probe (its slot stays occupied, so no probe
         pile-up), not the watchdog."""
         state = {"done": threading.Event(), "ok": False, "error": None}
-        rep.probe = state
+        # probe slot assignment under the fleet lock like every other
+        # Replica field the watchdog and dispatcher share — the probe
+        # attrs must not be the one family touched bare
+        with self.fleet._cond:
+            rep.probe = state
 
         def run():
             try:
@@ -240,21 +244,29 @@ class Watchdog:
 
     def _reap_probes(self) -> None:
         now = time.monotonic()
+        # all probe bookkeeping (slot clear, failure count, next-probe
+        # schedule) under the fleet lock — the same lock that guards
+        # these fields at ejection; counters and logging follow outside
+        reaped = []
         with self.fleet._cond:
-            candidates = [rep for rs in self.fleet._live_sets()
-                          for rep in rs.replicas
-                          if rep.probe is not None
-                          and rep.probe["done"].is_set()]
-        for rep in candidates:
-            state, rep.probe = rep.probe, None
+            for rs in self.fleet._live_sets():
+                for rep in rs.replicas:
+                    if rep.probe is None or not rep.probe["done"].is_set():
+                        continue
+                    state, rep.probe = rep.probe, None
+                    backoff = 0.0
+                    if not state["ok"]:
+                        rep.probe_failures += 1
+                        backoff = min(
+                            self.interval_s * (2 ** rep.probe_failures),
+                            PROBE_BACKOFF_MAX_S)
+                        rep.next_probe_t = now + backoff
+                    reaped.append((rep, state, backoff))
+        for rep, state, backoff in reaped:
             obs.inc("serve_probes_total")
             if state["ok"]:
                 self._readmit(rep)
             else:
-                rep.probe_failures += 1
-                backoff = min(self.interval_s * (2 ** rep.probe_failures),
-                              PROBE_BACKOFF_MAX_S)
-                rep.next_probe_t = now + backoff
                 obs.inc("serve_probe_failures_total")
                 log.warning("serve: probe of ejected replica %d (%s) "
                             "failed (%r); next probe in %.2fs",

@@ -1,0 +1,569 @@
+"""tools/graftcheck as a tier-1 gate.
+
+Three layers, mirroring how tests/test_phase_lint.py pins the phase
+lint:
+
+1. seeded-violation fixtures — tiny synthetic modules that MUST trip
+   each rule family (a rule that cannot catch its own seeded bug is
+   decoration, not a gate);
+2. the suppression syntax round-trips (inline, multi-rule, file-level)
+   and suppressed findings stay counted;
+3. the real repo is CLEAN: ``run_checks`` over this checkout returns
+   zero unsuppressed findings, which is what makes every rule a
+   regression gate for future PRs rather than advice.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.graftcheck import run_checks  # noqa: E402
+
+pytestmark = pytest.mark.graftcheck
+
+
+def _tree(tmp_path, files):
+    pkg = tmp_path / "lightgbm_tpu"
+    for rel, body in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+    return tmp_path
+
+
+def _rules(report):
+    return sorted({f.rule for f in report.findings})
+
+
+# -- family: locks -------------------------------------------------------
+
+def test_lock_order_inversion_trips(tmp_path):
+    root = _tree(tmp_path, {"ab.py": """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def forward(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def backward(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """})
+    report = run_checks(root, families=["locks"])
+    assert any(f.rule == "lock-order" and "inversion" in f.message
+               for f in report.findings), report.findings
+
+
+def test_lock_order_inversion_via_call_graph(tmp_path):
+    root = _tree(tmp_path, {"ab.py": """
+        import threading
+
+        class Fleet:
+            def __init__(self):
+                self._cond = threading.Lock()
+                self.batcher = None
+
+            def dispatch(self):
+                with self._cond:
+                    self.batcher.depth()
+
+        class Batcher:
+            def __init__(self, fleet):
+                self._lock = threading.Lock()
+                self.fleet = fleet
+
+            def depth(self):
+                with self._lock:
+                    return 0
+
+            def drain(self):
+                with self._lock:
+                    with self.fleet._cond:
+                        pass
+    """})
+    report = run_checks(root, families=["locks"])
+    assert any(f.rule == "lock-order" and "inversion" in f.message
+               for f in report.findings), report.findings
+
+
+def test_blocking_call_under_lock_trips(tmp_path):
+    root = _tree(tmp_path, {"blk.py": """
+        import subprocess
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._worker = threading.Thread(target=self._run,
+                                                daemon=True)
+
+            def _run(self):
+                pass
+
+            def stop(self):
+                with self._lock:
+                    self._worker.join()
+
+            def nap(self):
+                with self._lock:
+                    time.sleep(1.0)
+
+        def build():
+            with _lock:
+                subprocess.run(["true"])
+    """})
+    report = run_checks(root, families=["locks"])
+    msgs = [f.message for f in report.findings
+            if f.rule == "lock-blocking"]
+    assert any("thread join" in m for m in msgs), report.findings
+    assert any("time.sleep" in m for m in msgs), report.findings
+    assert any("subprocess" in m for m in msgs), report.findings
+
+
+def test_blocking_call_propagates_through_helper(tmp_path):
+    root = _tree(tmp_path, {"blk.py": """
+        import subprocess
+        import threading
+
+        _lock = threading.Lock()
+
+        def _compile():
+            subprocess.run(["g++"])
+
+        def get():
+            with _lock:
+                _compile()
+    """})
+    report = run_checks(root, families=["locks"])
+    assert any(f.rule == "lock-blocking" and "_compile" in f.message
+               for f in report.findings), report.findings
+
+
+def test_self_deadlock_via_call_chain_trips(tmp_path):
+    root = _tree(tmp_path, {"sd.py": """
+        import threading
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+
+            def outer(self):
+                with self._cond:
+                    self.inner()
+
+            def inner(self):
+                with self._cond:
+                    pass
+    """})
+    report = run_checks(root, families=["locks"])
+    assert any(f.rule == "lock-order" and "re-acquires" in f.message
+               for f in report.findings), report.findings
+
+
+def test_bare_condition_reacquisition_is_reentrant(tmp_path):
+    # threading.Condition() with no lock argument is RLock-backed:
+    # nested acquisition is legal and must not be flagged
+    root = _tree(tmp_path, {"ok.py": """
+        import threading
+
+        class B:
+            def __init__(self):
+                self._cond = threading.Condition()
+
+            def outer(self):
+                with self._cond:
+                    with self._cond:
+                        pass
+    """})
+    report = run_checks(root, families=["locks"])
+    assert report.findings == [], report.findings
+
+
+def test_condition_wait_on_held_lock_is_not_blocking(tmp_path):
+    root = _tree(tmp_path, {"ok.py": """
+        import threading
+
+        class B:
+            def __init__(self):
+                self._cond = threading.Condition()
+
+            def take(self):
+                with self._cond:
+                    self._cond.wait(timeout=0.1)
+    """})
+    report = run_checks(root, families=["locks"])
+    assert report.findings == []
+
+
+def test_shared_attr_mixed_locking_trips(tmp_path):
+    root = _tree(tmp_path, {"mix.py": """
+        import threading
+
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def locked_bump(self):
+                with self._lock:
+                    self.count += 1
+
+            def bare_bump(self):
+                self.count += 1
+    """})
+    report = run_checks(root, families=["locks"])
+    assert any(f.rule == "lock-shared-attr" and "count" in f.message
+               for f in report.findings), report.findings
+
+
+def test_shared_attr_locked_helper_is_clean(tmp_path):
+    # a *_locked helper and a helper only ever called under the lock
+    # are lock-guarded in fact — no finding
+    root = _tree(tmp_path, {"ok.py": """
+        import threading
+
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def bump(self):
+                with self._lock:
+                    self._bump_locked()
+                    self._accumulate()
+
+            def _bump_locked(self):
+                self.count += 1
+
+            def _accumulate(self):
+                self.count += 2
+    """})
+    report = run_checks(root, families=["locks"])
+    assert report.findings == []
+
+
+# -- family: tracer ------------------------------------------------------
+
+def test_host_effects_in_jitted_fn_trip(tmp_path):
+    root = _tree(tmp_path, {"jt.py": """
+        import time
+        import jax
+        import numpy as np
+        from .. import obs
+
+        @jax.jit
+        def step(x):
+            obs.inc("steps")
+            t = time.time()
+            noise = np.random.normal()
+            host = x.item()
+            return x + t + noise + host
+    """})
+    report = run_checks(root, families=["tracer"])
+    msgs = [f.message for f in report.findings
+            if f.rule == "jit-host-effect"]
+    assert any("registry write" in m for m in msgs), report.findings
+    assert any("time." in m for m in msgs), report.findings
+    assert any("RNG draw" in m for m in msgs), report.findings
+    assert any(".item()" in m for m in msgs), report.findings
+
+
+def test_fn_passed_to_jit_call_is_scanned(tmp_path):
+    root = _tree(tmp_path, {"jt.py": """
+        import jax
+
+        def impl(x):
+            print("tracing!")
+            return x
+
+        fn = jax.jit(impl)
+    """})
+    report = run_checks(root, families=["tracer"])
+    assert any(f.rule == "jit-host-effect" and "print" in f.message
+               for f in report.findings), report.findings
+
+
+def test_clean_jitted_fn_passes(tmp_path):
+    root = _tree(tmp_path, {"jt.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            return jnp.sum(x * 2.0)
+    """})
+    report = run_checks(root, families=["tracer"])
+    assert report.findings == []
+
+
+# -- family: jit ---------------------------------------------------------
+
+def test_raw_jax_jit_trips(tmp_path):
+    root = _tree(tmp_path, {"raw.py": """
+        import functools
+        import jax
+
+        @jax.jit
+        def a(x):
+            return x
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def b(x, n):
+            return x * n
+    """})
+    report = run_checks(root, families=["jit"])
+    raws = [f for f in report.findings if f.rule == "jit-raw"]
+    assert len(raws) == 2, report.findings
+
+
+def test_jit_of_lambda_and_jit_in_loop_trip(tmp_path):
+    root = _tree(tmp_path, {"cl.py": """
+        import jax
+
+        def build(fns):
+            out = []
+            for f in fns:
+                out.append(jax.jit(f))
+            g = jax.jit(lambda x: x + 1)
+            return out, g
+    """})
+    report = run_checks(root, families=["jit"])
+    closures = [f for f in report.findings if f.rule == "jit-closure"]
+    assert any("lambda" in f.message for f in closures), report.findings
+    assert any("loop" in f.message for f in closures), report.findings
+
+
+# -- family: lifecycle ---------------------------------------------------
+
+def test_undaemonized_unjoined_thread_trips(tmp_path):
+    root = _tree(tmp_path, {"th.py": """
+        import threading
+
+        class S:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def _run(self):
+                pass
+    """})
+    report = run_checks(root, families=["lifecycle"])
+    assert any(f.rule == "thread-lifecycle" for f in report.findings), \
+        report.findings
+
+
+def test_joined_or_daemon_threads_pass(tmp_path):
+    root = _tree(tmp_path, {"th.py": """
+        import threading
+
+        class S:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+                self._d = threading.Thread(target=self._run, daemon=True)
+                self._d.start()
+
+            def stop(self):
+                self._t.join(timeout=5.0)
+
+            def _run(self):
+                pass
+    """})
+    report = run_checks(root, families=["lifecycle"])
+    assert report.findings == []
+
+
+def test_socket_without_close_trips(tmp_path):
+    root = _tree(tmp_path, {"so.py": """
+        import socket
+
+        class Mesh:
+            def __init__(self):
+                self._sock = socket.socket(socket.AF_INET,
+                                           socket.SOCK_DGRAM)
+    """})
+    report = run_checks(root, families=["lifecycle"])
+    assert any(f.rule == "handle-close" and "_sock" in f.message
+               for f in report.findings), report.findings
+
+
+def test_local_open_without_close_trips(tmp_path):
+    root = _tree(tmp_path, {"fh.py": """
+        def leak(path):
+            fh = open(path)
+            return fh.read()
+
+        def fine(path):
+            with open(path) as fh:
+                return fh.read()
+
+        def also_fine(path):
+            fh = open(path)
+            try:
+                return fh.read()
+            finally:
+                fh.close()
+    """})
+    report = run_checks(root, families=["lifecycle"])
+    handle = [f for f in report.findings if f.rule == "handle-close"]
+    assert len(handle) == 1 and handle[0].line == 3, report.findings
+
+
+def test_wall_clock_in_deadline_math_trips(tmp_path):
+    root = _tree(tmp_path, {"ck.py": """
+        import time
+
+        def deadline(timeout):
+            start = time.time()
+            while time.time() - start < timeout:
+                pass
+
+        def stamp():
+            return {"t": round(time.time(), 3)}
+    """})
+    report = run_checks(root, families=["lifecycle"])
+    clocks = [f for f in report.findings if f.rule == "wall-clock"]
+    # the two deadline-math uses trip; the pure timestamp does not
+    assert {f.line for f in clocks} == {5, 6}, report.findings
+
+
+# -- suppression syntax --------------------------------------------------
+
+def test_inline_suppression_waives_and_counts(tmp_path):
+    root = _tree(tmp_path, {"raw.py": """
+        import jax
+
+        @jax.jit  # graftcheck: disable=jit-raw
+        def a(x):
+            return x
+
+        @jax.jit
+        def b(x):
+            return x
+    """})
+    report = run_checks(root, families=["jit"])
+    assert len(report.findings) == 1          # b stays live
+    assert len(report.suppressed) == 1        # a is waived, but counted
+    assert report.suppressed_counts() == {"jit-raw": 1}
+    assert report.exit_code == 1
+
+
+def test_multi_rule_and_file_suppressions(tmp_path):
+    root = _tree(tmp_path, {"multi.py": """
+        # graftcheck: disable-file=jit-closure
+        import jax
+
+        g = jax.jit(lambda x: x)  # graftcheck: disable=jit-raw,unused-rule
+
+        @jax.jit
+        def b(x):
+            return x
+    """})
+    report = run_checks(root, families=["jit"])
+    assert [f.rule for f in report.findings] == ["jit-raw"]  # only b
+    assert sorted(f.rule for f in report.suppressed) == [
+        "jit-closure", "jit-raw"]
+
+
+def test_disable_all_waives_everything_on_line(tmp_path):
+    root = _tree(tmp_path, {"a.py": """
+        import jax
+
+        @jax.jit  # graftcheck: disable=all
+        def a(x):
+            return x
+    """})
+    report = run_checks(root, families=["jit"])
+    assert report.findings == [] and len(report.suppressed) == 1
+    assert report.exit_code == 0
+
+
+# -- family: params ------------------------------------------------------
+
+def test_param_docs_drift_trips(tmp_path):
+    root = _tree(tmp_path, {"config.py": """
+        _DEFAULTS = {
+            "documented": 1,
+            "undocumented": 2,
+        }
+    """})
+    (root / "docs").mkdir()
+    (root / "docs" / "_param_descriptions.py").write_text(
+        'DESC = {"documented": "fine", "stale": "gone"}\n')
+    (root / "docs" / "Parameters.md").write_text("| `documented` |\n")
+    report = run_checks(root, families=["params"])
+    msgs = [f.message for f in report.findings]
+    assert any("'undocumented' has no description" in m for m in msgs)
+    assert any("'stale' matches no _DEFAULTS key" in m for m in msgs)
+    assert any("'undocumented' is missing from docs/Parameters.md" in m
+               for m in msgs)
+
+
+# -- the repo itself -----------------------------------------------------
+
+def test_repo_is_clean():
+    """The merge gate: zero unsuppressed findings on this checkout.
+    Waivers (inline suppressions) are allowed but must stay visible —
+    a regression in any rule family fails tier-1 right here."""
+    report = run_checks(REPO)
+    assert report.parse_errors == []
+    assert report.findings == [], "\n".join(
+        f.render() for f in report.findings)
+
+
+def test_repo_phase_family_matches_standalone_lint():
+    """The migrated phases family and the preserved standalone entry
+    point must agree (both clean, same implementation)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "lint_phase_scopes", REPO / "tools" / "lint_phase_scopes.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.check() == []
+
+
+# -- CLI contract --------------------------------------------------------
+
+def test_cli_exit_zero_and_json_on_clean_repo():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graftcheck", "--format=json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["findings"] == []
+    assert isinstance(doc["suppressed_counts"], dict)
+
+
+def test_cli_exit_one_on_findings(tmp_path):
+    root = _tree(tmp_path, {"raw.py": "import jax\nf = jax.jit(len)\n"})
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graftcheck",
+         f"--root={root}", "--rule=jit"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 1
+    assert "jit-raw" in out.stderr
+
+
+def test_cli_rejects_unknown_family():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graftcheck", "--rule=nonsense"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 2

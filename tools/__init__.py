@@ -1,0 +1,4 @@
+"""Repo tooling.  This package marker exists so ``python -m
+tools.graftcheck`` resolves from the repo root; the standalone scripts in
+this directory (bench_regress.py, lint_phase_scopes.py, ...) keep running
+by file path as before."""

@@ -1,0 +1,37 @@
+"""graftcheck: repo-wide static analysis for the invariants the test
+suite can only catch after the fact.
+
+PRs 7-11 made this reproduction a genuinely concurrent system — a
+serving fleet, per-replica micro-batchers, a UDP heartbeat mesh, a
+process-wide shared-jit registry — whose correctness rests on invariants
+nothing used to check statically:
+
+- locks are acquired in one global order, and nothing blocks (thread
+  joins, sockets, subprocesses, device dispatch, sleeps) while holding
+  one;
+- state shared between threads is touched under the lock that guards it
+  everywhere, not just in the convenient call sites;
+- functions handed to a jit entry point carry no host side effects that
+  would bake at trace time (registry counters, wall clocks, np.random,
+  ``.item()`` host syncs);
+- every repo jit is routed through ``obs.instrumented_jit`` /
+  ``CountingJit`` so the compile ledger has no blind spots, and no
+  call site hands jax a fresh lambda per call (the function-identity
+  cache defeat PR 9 had to work around);
+- threads are daemonized or joined, sockets/handles have a close path,
+  and deadline/timeout math never reads the wall clock;
+- the host/device phase taxonomy stays in sync with ``obs/phases.py``
+  (the former ``tools/lint_phase_scopes.py``, now a rule family here);
+- every ``config.py`` parameter is documented (``_param_descriptions``)
+  and rendered in ``docs/Parameters.md``.
+
+Run ``python -m tools.graftcheck`` from the repo root (exit 1 on any
+unsuppressed finding), or as the tier-1 test ``tests/test_graftcheck.py``.
+Intentional exceptions are waived inline with
+``# graftcheck: disable=<rule>`` — visible, counted, and reported so
+waivers cannot accumulate silently.  See docs/STATIC_ANALYSIS.md for the
+rule catalogue.
+"""
+
+from .core import (Finding, ModuleInfo, Project, Report,  # noqa: F401
+                   RULE_FAMILIES, run_checks)

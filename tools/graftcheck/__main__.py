@@ -1,0 +1,81 @@
+"""CLI: ``python -m tools.graftcheck [--format=table|json]
+[--rule=family,...] [--root=PATH]``.
+
+Exit codes: 0 clean (suppressed findings allowed, but reported), 1 on
+any unsuppressed finding or unparseable file, 2 on usage errors.  This
+is the same contract the tier-1 test (tests/test_graftcheck.py) pins,
+wired the same way the phase lint always was.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from .core import RULE_FAMILIES, run_checks
+
+
+def main(argv=None) -> int:
+    from . import rules  # noqa: F401 - registers families for --list-rules
+
+    repo_root = pathlib.Path(__file__).resolve().parent.parent.parent
+    p = argparse.ArgumentParser(
+        prog="python -m tools.graftcheck",
+        description="Static analysis: lock discipline, jit tracer "
+                    "safety, recompile hazards, thread/clock lifecycle, "
+                    "phase taxonomy, parameter docs.")
+    p.add_argument("--format", choices=("table", "json"), default="table")
+    p.add_argument("--rule", action="append", metavar="FAMILY",
+                   help="run only these rule families (comma-separable, "
+                        "repeatable); default: all")
+    p.add_argument("--root", default=str(repo_root),
+                   help="repo root to analyze (default: this checkout)")
+    p.add_argument("--pkg", default="lightgbm_tpu",
+                   help="package dir under the root (default: "
+                        "lightgbm_tpu)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="list rule families and exit")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(RULE_FAMILIES):
+            print(name)
+        return 0
+
+    families = None
+    if args.rule:
+        families = [f.strip() for chunk in args.rule
+                    for f in chunk.split(",") if f.strip()]
+    try:
+        report = run_checks(args.root, families=families,
+                            pkg_rel=args.pkg)
+    except ValueError as exc:
+        print(f"graftcheck: {exc}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps(report.to_dict(), indent=2))
+        return report.exit_code
+
+    for f in report.parse_errors:
+        print(f.render(), file=sys.stderr)
+    for f in report.findings:
+        print(f.render(), file=sys.stderr)
+    counts = report.suppressed_counts()
+    waived = ", ".join(f"{k}={v}" for k, v in counts.items()) or "none"
+    if report.findings or report.parse_errors:
+        print(f"graftcheck: {len(report.findings)} finding(s), "
+              f"{len(report.suppressed)} suppressed ({waived})",
+              file=sys.stderr)
+    else:
+        print(f"graftcheck: clean ({len(report.families)} rule "
+              f"families; suppressed waivers: {waived})")
+        for f in report.suppressed:
+            print(f"  waived: {f.render()}")
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,183 @@
+"""Shared walker + finding/suppression model for the graftcheck rules.
+
+One design decision carries the whole suite: every rule family consumes
+the same :class:`Project`, which reads and ``ast.parse``s each package
+file exactly ONCE.  Rule families never touch the filesystem themselves
+(the params family reads the two docs files it audits, nothing else), so
+adding a rule costs zero additional parses — the property the old
+standalone ``lint_phase_scopes.py`` regex pass lacked.
+
+Suppressions are inline comments::
+
+    self._fh = open(path)   # graftcheck: disable=handle-close
+
+``disable=a,b`` waives several rules on that line; ``disable=all``
+waives every rule; ``# graftcheck: disable-file=<rule>`` anywhere in a
+file waives the rule for the whole file.  Suppressed findings are not
+dropped — they are reported and counted separately, so waivers stay
+visible and cannot accumulate silently.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
+
+SUPPRESS_RE = re.compile(r"#\s*graftcheck:\s*disable=([\w\-, ]+)")
+FILE_SUPPRESS_RE = re.compile(r"#\s*graftcheck:\s*disable-file=([\w\-, ]+)")
+
+
+@dataclass
+class Finding:
+    """One rule violation at a file/line."""
+
+    rule: str
+    path: str          # repo-root-relative, "/"-separated
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+def _parse_rules(raw: str) -> Set[str]:
+    return {r.strip() for r in raw.split(",") if r.strip()}
+
+
+class ModuleInfo:
+    """One package file: text + AST (parsed once) + suppression map."""
+
+    def __init__(self, path: pathlib.Path, root: pathlib.Path):
+        self.path = path
+        self.rel = path.relative_to(root).as_posix()
+        self.text = path.read_text()
+        self.tree = ast.parse(self.text, filename=str(path))
+        self.suppressions: Dict[int, Set[str]] = {}
+        self.file_suppressions: Set[str] = set()
+        for lineno, line in enumerate(self.text.splitlines(), 1):
+            m = FILE_SUPPRESS_RE.search(line)
+            if m:
+                self.file_suppressions |= _parse_rules(m.group(1))
+                continue
+            m = SUPPRESS_RE.search(line)
+            if m:
+                self.suppressions.setdefault(
+                    lineno, set()).update(_parse_rules(m.group(1)))
+
+
+class Project:
+    """The analyzed tree: every package module, read+parsed once."""
+
+    def __init__(self, root, pkg_rel: str = "lightgbm_tpu"):
+        self.root = pathlib.Path(root).resolve()
+        self.pkg_rel = str(pkg_rel)
+        self.pkg = self.root / self.pkg_rel
+        self.modules: List[ModuleInfo] = []
+        self.parse_errors: List[Finding] = []
+        for p in sorted(self.pkg.rglob("*.py")):
+            if "__pycache__" in p.parts:
+                continue
+            try:
+                self.modules.append(ModuleInfo(p, self.root))
+            except (SyntaxError, UnicodeDecodeError) as exc:
+                self.parse_errors.append(Finding(
+                    "parse-error", p.relative_to(self.root).as_posix(),
+                    getattr(exc, "lineno", 1) or 1,
+                    f"could not parse: {exc}"))
+        self._by_rel = {m.rel: m for m in self.modules}
+        self._index = None
+
+    def module(self, rel: str) -> Optional[ModuleInfo]:
+        return self._by_rel.get(rel)
+
+    @property
+    def index(self):
+        """The lock/thread/call-graph index, built lazily and shared by
+        every rule family that needs it (one build per run)."""
+        if self._index is None:
+            from .index import ProjectIndex
+            self._index = ProjectIndex(self)
+        return self._index
+
+    def is_suppressed(self, f: Finding) -> bool:
+        mod = self._by_rel.get(f.path)
+        if mod is None:
+            return False
+        rules = mod.file_suppressions | mod.suppressions.get(f.line, set())
+        return "all" in rules or f.rule in rules
+
+
+# -- rule-family registry -----------------------------------------------
+
+RULE_FAMILIES: Dict[str, Callable[[Project], List[Finding]]] = {}
+
+
+def family(name: str):
+    """Register a rule family: ``fn(project) -> [Finding]``."""
+    def deco(fn):
+        RULE_FAMILIES[name] = fn
+        return fn
+    return deco
+
+
+@dataclass
+class Report:
+    """One analyzer run: live findings, suppressed findings, and the
+    families that ran."""
+
+    findings: List[Finding]
+    suppressed: List[Finding]
+    families: List[str]
+    parse_errors: List[Finding] = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if (self.findings or self.parse_errors) else 0
+
+    def suppressed_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for f in self.suppressed:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return dict(sorted(counts.items()))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "families": list(self.families),
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "suppressed_counts": self.suppressed_counts(),
+            "parse_errors": [f.to_dict() for f in self.parse_errors],
+        }
+
+
+def run_checks(root, families: Optional[Sequence[str]] = None,
+               pkg_rel: str = "lightgbm_tpu",
+               project: Optional[Project] = None) -> Report:
+    """Run rule families over the tree at ``root`` (all families by
+    default).  Raises ``ValueError`` for an unknown family name."""
+    from . import rules  # noqa: F401 - registers the families
+
+    if project is None:
+        project = Project(root, pkg_rel=pkg_rel)
+    names = list(families) if families else sorted(RULE_FAMILIES)
+    unknown = [n for n in names if n not in RULE_FAMILIES]
+    if unknown:
+        raise ValueError(
+            f"unknown rule families {unknown}; "
+            f"known: {sorted(RULE_FAMILIES)}")
+    collected: List[Finding] = []
+    for n in names:
+        collected.extend(RULE_FAMILIES[n](project))
+    collected.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    live: List[Finding] = []
+    waived: List[Finding] = []
+    for f in collected:
+        (waived if project.is_suppressed(f) else live).append(f)
+    return Report(live, waived, names, parse_errors=project.parse_errors)

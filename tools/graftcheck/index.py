@@ -1,0 +1,695 @@
+"""Cross-module index shared by the lock and lifecycle rule families.
+
+Built once per run from the :class:`~.core.Project`'s already-parsed
+ASTs, this maps the concurrency vocabulary of the codebase:
+
+- every named ``threading.Lock``/``RLock``/``Condition`` (class attr or
+  module global), with ``Condition(self._lock)`` aliased onto the lock
+  it wraps — ``with self._cond`` and ``with self._lock`` are the same
+  runtime lock in ``serve/batcher.py``;
+- every ``threading.Thread``/``Event`` and ``socket.socket``/HTTP-server
+  attribute (the lifecycle rules' subjects);
+- per-function summaries: which locks a function acquires (lexically,
+  via ``with``), every call made and the lock stack held at that point,
+  every blocking operation (thread join, socket I/O, subprocess,
+  ``time.sleep``, ``Event.wait``, device dispatch), and every attribute
+  write with its held-lock context;
+- a best-effort intra-repo call graph (``self.m()``, same-module
+  functions, imported modules' functions, and receiver-name matching
+  like ``fleet._cond`` -> ``Fleet``), over which ``may_acquire`` /
+  ``may_block`` summaries are propagated to a fixed point.
+
+Resolution is deliberately conservative: an expression that cannot be
+confidently mapped to a lock/class/function participates in NO finding.
+A lint that guesses produces noise; noise gets suppressed wholesale;
+and a wholesale-suppressed lint protects nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# blocking-call vocabulary -------------------------------------------------
+
+_SOCKET_METHODS = {"recv", "recvfrom", "sendto", "accept", "connect",
+                   "send", "sendall"}
+_SUBPROCESS_FUNCS = {"run", "call", "check_call", "check_output", "Popen"}
+# time.sleep under a lock below this constant duration is tolerated
+# (sub-10ms backoff spins); unknown/larger durations are findings
+SLEEP_THRESHOLD_S = 0.01
+# calls that dispatch device work (an XLA predict/compile can take
+# seconds to minutes — never inside a lock)
+_DEVICE_DISPATCH = {"predict_fn", "warmup"}
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def receiver_name(node: ast.AST) -> Optional[str]:
+    """The name a method/attr hangs off: ``rep.batcher.submit`` -> the
+    receiver of ``submit`` is ``batcher``; ``self.fleet._cond`` -> the
+    receiver of ``_cond`` is ``fleet``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+@dataclass
+class CallSite:
+    node: ast.Call
+    held: Tuple[str, ...]
+    line: int
+
+
+@dataclass
+class AttrWrite:
+    owner: Optional[str]       # class key "mod::Class", None if unknown
+    attr: str
+    line: int
+    held: Tuple[str, ...]
+    is_self: bool
+
+
+@dataclass
+class FuncInfo:
+    fid: str                   # "mod::Class.name" / "mod::name"
+    module: str                # module rel path
+    cls: Optional[str]         # class key or None
+    name: str
+    node: ast.AST
+    is_init: bool = False
+    acquires: List[Tuple[str, int, Tuple[str, ...]]] = field(
+        default_factory=list)
+    calls: List[CallSite] = field(default_factory=list)
+    blocking: List[Tuple[str, int, Tuple[str, ...]]] = field(
+        default_factory=list)
+    attr_writes: List[AttrWrite] = field(default_factory=list)
+    local_funcs: Dict[str, str] = field(default_factory=dict)
+    # fixed-point summaries
+    may_acquire: Set[str] = field(default_factory=set)
+    may_block: Set[str] = field(default_factory=set)   # descriptions
+
+
+@dataclass
+class ClassInfo:
+    key: str                   # "mod::Name"
+    name: str
+    module: str
+    node: ast.ClassDef
+    bases: List[str] = field(default_factory=list)     # last-part names
+    # attr -> canonical attr (Condition(self._lock) aliases onto _lock)
+    lock_attrs: Dict[str, str] = field(default_factory=dict)
+    lock_kinds: Dict[str, str] = field(default_factory=dict)
+    thread_attrs: Set[str] = field(default_factory=set)
+    event_attrs: Set[str] = field(default_factory=set)
+    handle_attrs: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    self_attrs: Set[str] = field(default_factory=set)
+    methods: Dict[str, str] = field(default_factory=dict)  # name -> fid
+
+
+class ModuleIndexData:
+    def __init__(self, rel: str):
+        self.rel = rel
+        # local import bindings: name -> ("module", rel) | ("stdlib", top)
+        self.imports: Dict[str, Tuple[str, str]] = {}
+        self.module_locks: Dict[str, str] = {}     # name -> kind
+        self.module_funcs: Dict[str, str] = {}     # name -> fid
+        self.classes: Dict[str, ClassInfo] = {}    # class name -> info
+
+
+class ProjectIndex:
+    """See module docstring.  Built from an already-parsed Project."""
+
+    def __init__(self, project):
+        self.project = project
+        self.mods: Dict[str, ModuleIndexData] = {}
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}          # key -> info
+        self.class_by_name: Dict[str, List[ClassInfo]] = {}
+        self.lock_owner: Dict[str, List[ClassInfo]] = {}  # attr -> classes
+        self.attr_owner: Dict[str, List[ClassInfo]] = {}
+        self.method_owner: Dict[str, List[ClassInfo]] = {}
+        self.thread_names: Set[str] = set()
+        self.event_names: Set[str] = set()
+        self.socket_names: Set[str] = set()
+        self.held_ctx: Set[str] = set()         # fids always under a lock
+        self.callers: Dict[str, List[Tuple[str, bool]]] = {}
+        for m in project.modules:
+            self._scan_module(m)
+        self._build_global_maps()
+        for m in project.modules:
+            self._analyze_module_functions(m)
+        self._propagate()
+        self._compute_held_contexts()
+
+    # -- pass 1: declarations -------------------------------------------
+
+    def _scan_module(self, m) -> None:
+        data = ModuleIndexData(m.rel)
+        self.mods[m.rel] = data
+        mod_dir_parts = list(m.path.parent.relative_to(
+            self.project.root).parts)
+        for node in m.tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    top = a.name.split(".")[0]
+                    data.imports[a.asname or top] = ("stdlib", top)
+            elif isinstance(node, ast.ImportFrom):
+                self._bind_import_from(data, node, mod_dir_parts)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                kind = self._lock_ctor_kind(node.value, data)
+                if kind:
+                    data.module_locks[node.targets[0].id] = kind
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fid = f"{m.rel}::{node.name}"
+                data.module_funcs[node.name] = fid
+                self.funcs[fid] = FuncInfo(fid, m.rel, None, node.name,
+                                           node)
+            elif isinstance(node, ast.ClassDef):
+                self._scan_class(data, m, node)
+
+    def _bind_import_from(self, data: ModuleIndexData,
+                          node: ast.ImportFrom, mod_dir: List[str]) -> None:
+        if node.level == 0:
+            top = (node.module or "").split(".")[0]
+            for a in node.names:
+                data.imports.setdefault(a.asname or a.name,
+                                        ("stdlib", top))
+            return
+        base = mod_dir[: len(mod_dir) - (node.level - 1)]
+        if node.module:
+            base = base + node.module.split(".")
+        root = self.project.root
+        for a in node.names:
+            cand = base + [a.name]
+            p = root.joinpath(*cand)
+            if p.with_suffix(".py").exists():
+                data.imports[a.asname or a.name] = (
+                    "module", p.with_suffix(".py").relative_to(
+                        root).as_posix())
+            elif (p / "__init__.py").exists():
+                data.imports[a.asname or a.name] = (
+                    "module", (p / "__init__.py").relative_to(
+                        root).as_posix())
+            else:
+                bp = root.joinpath(*base)
+                target = (bp.with_suffix(".py") if
+                          bp.with_suffix(".py").exists()
+                          else bp / "__init__.py")
+                if target.exists():
+                    data.imports[a.asname or a.name] = (
+                        "symbol:" + a.name,
+                        target.relative_to(root).as_posix())
+
+    def _is_module_ref(self, data: ModuleIndexData, name: str,
+                       stdlib: str) -> bool:
+        binding = data.imports.get(name)
+        return binding is not None and binding[0] == "stdlib" \
+            and binding[1] == stdlib
+
+    def _lock_ctor_kind(self, value: ast.AST,
+                        data: ModuleIndexData) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        d = dotted(value.func)
+        if d in ("threading.Lock", "threading.RLock",
+                 "threading.Condition"):
+            return d.split(".")[1]
+        if d in ("Lock", "RLock", "Condition") \
+                and data.imports.get(d) == ("stdlib", "threading"):
+            return d
+        return None
+
+    def _scan_class(self, data: ModuleIndexData, m,
+                    node: ast.ClassDef) -> None:
+        key = f"{m.rel}::{node.name}"
+        info = ClassInfo(key, node.name, m.rel, node,
+                         bases=[b.split(".")[-1] for b in
+                                (dotted(x) for x in node.bases) if b])
+        data.classes[node.name] = info
+        self.classes[key] = info
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            fid = f"{m.rel}::{node.name}.{item.name}"
+            info.methods[item.name] = fid
+            self.funcs[fid] = FuncInfo(
+                fid, m.rel, key, item.name, item,
+                is_init=item.name in ("__init__", "__new__",
+                                      "__post_init__"))
+            for sub in ast.walk(item):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    tgt = sub.targets[0]
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        info.self_attrs.add(tgt.attr)
+                        self._classify_ctor(info, tgt.attr, sub.value,
+                                            data, sub.lineno)
+                elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                    tgt = sub.target
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        info.self_attrs.add(tgt.attr)
+
+    def _classify_ctor(self, info: ClassInfo, attr: str, value: ast.AST,
+                       data: ModuleIndexData, lineno: int) -> None:
+        kind = self._lock_ctor_kind(value, data)
+        if kind:
+            canonical = attr
+            if kind == "Condition" and isinstance(value, ast.Call) \
+                    and value.args:
+                a0 = value.args[0]
+                if isinstance(a0, ast.Attribute) and \
+                        isinstance(a0.value, ast.Name) and \
+                        a0.value.id == "self" and a0.attr in info.lock_attrs:
+                    canonical = info.lock_attrs[a0.attr]
+            info.lock_attrs[attr] = canonical
+            info.lock_kinds[attr] = kind
+            return
+        if not isinstance(value, ast.Call):
+            return
+        d = dotted(value.func) or ""
+        last = d.split(".")[-1]
+        if d in ("threading.Thread",) or last == "Thread":
+            info.thread_attrs.add(attr)
+        elif d in ("threading.Event",) or last == "Event":
+            info.event_attrs.add(attr)
+        elif d in ("socket.socket",):
+            info.handle_attrs[attr] = ("socket", lineno)
+        elif last in ("ThreadingHTTPServer", "HTTPServer",
+                      "TCPServer", "UDPServer"):
+            info.handle_attrs[attr] = ("server", lineno)
+        elif isinstance(value.func, ast.Name) and value.func.id == "open":
+            info.handle_attrs[attr] = ("file", lineno)
+
+    def _build_global_maps(self) -> None:
+        for info in self.classes.values():
+            self.class_by_name.setdefault(info.name, []).append(info)
+            for attr in info.lock_attrs:
+                self.lock_owner.setdefault(attr, []).append(info)
+            for attr in info.self_attrs:
+                self.attr_owner.setdefault(attr, []).append(info)
+            for name in info.methods:
+                self.method_owner.setdefault(name, []).append(info)
+            self.thread_names |= info.thread_attrs
+            self.event_names |= info.event_attrs
+            self.socket_names |= {a for a, (k, _) in
+                                  info.handle_attrs.items()
+                                  if k == "socket"}
+
+    # -- resolution ------------------------------------------------------
+
+    def _class_for_receiver(self, recv: str,
+                            candidates: Sequence[ClassInfo]
+                            ) -> Optional[ClassInfo]:
+        """Pick the class a receiver name plausibly denotes: exact,
+        suffix, or prefix match on the lowered class name (``fleet`` ->
+        ``Fleet``, ``batcher`` -> ``MicroBatcher``, ``rep`` ->
+        ``Replica``).  Ambiguity -> None."""
+        r = recv.lower().lstrip("_")
+        if not r or r == "self":
+            return None
+        hits = []
+        for c in candidates:
+            cl = c.name.lower().lstrip("_")
+            if cl == r or cl.endswith(r) or cl.startswith(r):
+                hits.append(c)
+        return hits[0] if len(hits) == 1 else None
+
+    def lock_key(self, info: ClassInfo, attr: str) -> str:
+        return f"{info.key}.{info.lock_attrs.get(attr, attr)}"
+
+    def lock_kind(self, key: str) -> Optional[str]:
+        mod_cls, _, attr = key.rpartition(".")
+        info = self.classes.get(mod_cls)
+        if info is not None:
+            return info.lock_kinds.get(attr)
+        rel, _, name = key.rpartition("::")
+        data = self.mods.get(rel)
+        return data.module_locks.get(name) if data else None
+
+    def resolve_lock(self, expr: ast.AST, module: str,
+                     cls: Optional[str]) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            data = self.mods.get(module)
+            if data and expr.id in data.module_locks:
+                return f"{module}::{expr.id}"
+            return None
+        if not isinstance(expr, ast.Attribute):
+            return None
+        attr = expr.attr
+        recv = expr.value
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            info = self.classes.get(cls) if cls else None
+            if info and attr in info.lock_attrs:
+                return self.lock_key(info, attr)
+            return None
+        candidates = self.lock_owner.get(attr, [])
+        if len(candidates) == 1:
+            return self.lock_key(candidates[0], attr)
+        r = receiver_name(recv)
+        if r:
+            hit = self._class_for_receiver(r, candidates)
+            if hit is not None:
+                return self.lock_key(hit, attr)
+        return None
+
+    def _method_in_hierarchy(self, info: ClassInfo,
+                             name: str) -> Optional[str]:
+        seen = set()
+        stack = [info]
+        while stack:
+            c = stack.pop()
+            if c.key in seen:
+                continue
+            seen.add(c.key)
+            if name in c.methods:
+                return c.methods[name]
+            for b in c.bases:
+                stack.extend(self.class_by_name.get(b, []))
+        return None
+
+    def resolve_call(self, call: ast.Call, module: str,
+                     cls: Optional[str],
+                     local_funcs: Dict[str, str]) -> Optional[str]:
+        f = call.func
+        data = self.mods.get(module)
+        if isinstance(f, ast.Name):
+            if f.id in local_funcs:
+                return local_funcs[f.id]
+            if data:
+                if f.id in data.module_funcs:
+                    return data.module_funcs[f.id]
+                b = data.imports.get(f.id)
+                if b and b[0].startswith("symbol:"):
+                    target = self.mods.get(b[1])
+                    if target:
+                        return target.module_funcs.get(
+                            b[0].split(":", 1)[1])
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        mname = f.attr
+        recv = f.value
+        if isinstance(recv, ast.Name):
+            if recv.id == "self" and cls:
+                info = self.classes.get(cls)
+                if info:
+                    hit = self._method_in_hierarchy(info, mname)
+                    if hit:
+                        return hit
+                candidates = self.method_owner.get(mname, [])
+                if len(candidates) == 1:
+                    return candidates[0].methods[mname]
+                return None
+            if data:
+                b = data.imports.get(recv.id)
+                if b and b[0] == "module":
+                    target = self.mods.get(b[1])
+                    if target:
+                        return target.module_funcs.get(mname)
+        r = receiver_name(recv)
+        candidates = self.method_owner.get(mname, [])
+        if r:
+            hit = self._class_for_receiver(r, candidates)
+            if hit is not None:
+                return hit.methods[mname]
+        if len(candidates) == 1 and not mname.startswith("__"):
+            return candidates[0].methods[mname]
+        return None
+
+    def resolve_attr_owner(self, target: ast.Attribute, module: str,
+                           cls: Optional[str]
+                           ) -> Tuple[Optional[str], bool]:
+        """(owning class key, is_self) for an attribute STORE."""
+        recv = target.value
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            return cls, True
+        candidates = self.attr_owner.get(target.attr, [])
+        if len(candidates) == 1:
+            return candidates[0].key, False
+        r = receiver_name(recv)
+        if r:
+            hit = self._class_for_receiver(r, candidates)
+            if hit is not None:
+                return hit.key, False
+        return None, False
+
+    # -- pass 2: per-function analysis -----------------------------------
+
+    def _analyze_module_functions(self, m) -> None:
+        for node in m.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._analyze_function(m.rel, None, node,
+                                       f"{m.rel}::{node.name}")
+            elif isinstance(node, ast.ClassDef):
+                key = f"{m.rel}::{node.name}"
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._analyze_function(
+                            m.rel, key, item,
+                            f"{m.rel}::{node.name}.{item.name}")
+
+    def _analyze_function(self, module: str, cls: Optional[str],
+                          node, fid: str) -> None:
+        fn = self.funcs.get(fid)
+        if fn is None:
+            fn = self.funcs[fid] = FuncInfo(fid, module, cls, node.name,
+                                            node)
+        self._walk_body(fn, node.body, ())
+
+    def _walk_body(self, fn: FuncInfo, stmts, held: Tuple[str, ...]
+                   ) -> None:
+        for st in stmts:
+            self._walk_stmt(fn, st, held)
+
+    def _walk_stmt(self, fn: FuncInfo, st, held: Tuple[str, ...]) -> None:
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            new = held
+            for item in st.items:
+                self._visit_expr(fn, item.context_expr, new)
+                key = self.resolve_lock(item.context_expr, fn.module,
+                                        fn.cls)
+                if key:
+                    fn.acquires.append((key, item.context_expr.lineno,
+                                        new))
+                    new = new + (key,)
+            self._walk_body(fn, st.body, new)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def's body runs LATER, without the current locks
+            # (worker loops, probe closures) — analyze it as its own
+            # function with an empty held stack
+            nested_fid = f"{fn.fid}.<locals>.{st.name}"
+            fn.local_funcs[st.name] = nested_fid
+            self.funcs[nested_fid] = FuncInfo(nested_fid, fn.module,
+                                              fn.cls, st.name, st)
+            self._analyze_function(fn.module, fn.cls, st, nested_fid)
+            for dec in st.decorator_list:
+                self._visit_expr(fn, dec, held)
+        elif isinstance(st, ast.ClassDef):
+            pass
+        elif isinstance(st, ast.Try):
+            self._walk_body(fn, st.body, held)
+            for h in st.handlers:
+                self._walk_body(fn, h.body, held)
+            self._walk_body(fn, st.orelse, held)
+            self._walk_body(fn, st.finalbody, held)
+        elif isinstance(st, (ast.If, ast.While)):
+            self._visit_expr(fn, st.test, held)
+            self._walk_body(fn, st.body, held)
+            self._walk_body(fn, st.orelse, held)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self._visit_expr(fn, st.iter, held)
+            self._record_writes(fn, [st.target], held)
+            self._walk_body(fn, st.body, held)
+            self._walk_body(fn, st.orelse, held)
+        elif isinstance(st, ast.Assign):
+            self._visit_expr(fn, st.value, held)
+            self._record_writes(fn, st.targets, held)
+        elif isinstance(st, ast.AugAssign):
+            self._visit_expr(fn, st.value, held)
+            self._record_writes(fn, [st.target], held)
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._visit_expr(fn, st.value, held)
+                self._record_writes(fn, [st.target], held)
+        else:
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self._visit_expr(fn, child, held)
+                elif isinstance(child, ast.stmt):
+                    self._walk_stmt(fn, child, held)
+
+    def _record_writes(self, fn: FuncInfo, targets, held) -> None:
+        stack = list(targets)
+        while stack:
+            t = stack.pop()
+            if isinstance(t, (ast.Tuple, ast.List)):
+                stack.extend(t.elts)
+            elif isinstance(t, ast.Starred):
+                stack.append(t.value)
+            elif isinstance(t, ast.Attribute):
+                owner, is_self = self.resolve_attr_owner(t, fn.module,
+                                                         fn.cls)
+                fn.attr_writes.append(AttrWrite(owner, t.attr, t.lineno,
+                                                held, is_self))
+
+    def _visit_expr(self, fn: FuncInfo, expr, held: Tuple[str, ...]
+                    ) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Lambda):
+                continue  # body runs later; children still walked by
+                # ast.walk, which is acceptable over-approximation for
+                # CALL collection but lambdas rarely lock
+            if isinstance(node, ast.Call):
+                fn.calls.append(CallSite(node, held, node.lineno))
+                desc = self._blocking_desc(fn, node, held)
+                if desc:
+                    fn.blocking.append((desc, node.lineno, held))
+            elif isinstance(node, ast.NamedExpr) and \
+                    isinstance(node.target, ast.Attribute):
+                owner, is_self = self.resolve_attr_owner(
+                    node.target, fn.module, fn.cls)
+                fn.attr_writes.append(AttrWrite(
+                    owner, node.target.attr, node.lineno, held, is_self))
+
+    # -- blocking classification ----------------------------------------
+
+    def _is_named_like(self, recv: ast.AST, known: Set[str],
+                       hints: Tuple[str, ...]) -> bool:
+        r = receiver_name(recv)
+        if r is None:
+            return False
+        if r in known:
+            return True
+        rl = r.lower()
+        return any(h in rl for h in hints)
+
+    def _blocking_desc(self, fn: FuncInfo, call: ast.Call,
+                       held: Tuple[str, ...]) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in _DEVICE_DISPATCH:
+                return f"device dispatch {f.id}()"
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        name = f.attr
+        recv = f.value
+        data = self.mods.get(fn.module)
+        if name == "join" and self._is_named_like(
+                recv, self.thread_names, ("thread", "worker", "proc")):
+            return "thread join"
+        if name in _SOCKET_METHODS and self._is_named_like(
+                recv, self.socket_names, ("sock",)):
+            return f"socket {name}()"
+        if name in _SUBPROCESS_FUNCS and isinstance(recv, ast.Name) \
+                and data and self._is_module_ref(data, recv.id,
+                                                 "subprocess"):
+            return f"subprocess.{name}()"
+        if name == "sleep" and isinstance(recv, ast.Name) and data \
+                and self._is_module_ref(data, recv.id, "time"):
+            if call.args and isinstance(call.args[0], ast.Constant) \
+                    and isinstance(call.args[0].value, (int, float)) \
+                    and call.args[0].value < SLEEP_THRESHOLD_S:
+                return None
+            return "time.sleep()"
+        if name == "wait":
+            # Condition.wait on the lock you hold RELEASES it — never a
+            # finding; Event.wait never releases anything
+            lock = self.resolve_lock(recv, fn.module, fn.cls)
+            if lock is not None:
+                return None
+            if self._is_named_like(recv, self.event_names, ()):
+                return "Event.wait()"
+            return None
+        if name in _DEVICE_DISPATCH:
+            return f"device dispatch .{name}()"
+        return None
+
+    # -- fixed points ----------------------------------------------------
+
+    def _propagate(self) -> None:
+        """may_acquire / may_block to a fixed point over resolved calls."""
+        edges: Dict[str, Set[str]] = {}
+        for fn in self.funcs.values():
+            fn.may_acquire = {k for k, _, _ in fn.acquires}
+            fn.may_block = {
+                f"{d} ({fn.module}:{line})" for d, line, _ in fn.blocking}
+            out = edges.setdefault(fn.fid, set())
+            for site in fn.calls:
+                callee = self.resolve_call(site.node, fn.module, fn.cls,
+                                           fn.local_funcs)
+                if callee and callee in self.funcs:
+                    out.add(callee)
+        changed = True
+        guard = 0
+        while changed and guard < 100:
+            changed = False
+            guard += 1
+            for fn in self.funcs.values():
+                for callee in edges.get(fn.fid, ()):
+                    c = self.funcs[callee]
+                    if not c.may_acquire <= fn.may_acquire:
+                        fn.may_acquire |= c.may_acquire
+                        changed = True
+                    blk = {f"via {c.name}(): {d}" if not
+                           d.startswith("via ") else d
+                           for d in c.may_block}
+                    if not blk <= fn.may_block:
+                        fn.may_block |= blk
+                        changed = True
+        self.call_edges = edges
+
+    def _compute_held_contexts(self) -> None:
+        """fids whose EVERY resolved call site runs with a lock held (or
+        from another held context) — ``_route`` is only ever called
+        under the fleet condition, so its bare writes are lock-guarded
+        in fact even though no ``with`` is lexically visible."""
+        callers: Dict[str, List[Tuple[str, bool]]] = {}
+        for fn in self.funcs.values():
+            for site in fn.calls:
+                callee = self.resolve_call(site.node, fn.module, fn.cls,
+                                           fn.local_funcs)
+                if callee and callee in self.funcs:
+                    callers.setdefault(callee, []).append(
+                        (fn.fid, bool(site.held)))
+        self.callers = callers
+        held = {fid for fid, fn in self.funcs.items()
+                if fid in callers or fn.name.endswith("_locked")}
+        changed = True
+        guard = 0
+        while changed and guard < 100:
+            changed = False
+            guard += 1
+            for fid in list(held):
+                fn = self.funcs[fid]
+                if fn.name.endswith("_locked"):
+                    continue
+                ok = all(under or caller in held
+                         for caller, under in callers.get(fid, ()))
+                if not ok:
+                    held.discard(fid)
+                    changed = True
+        self.held_ctx = held
+
+    def is_held_context(self, fid: str) -> bool:
+        return fid in self.held_ctx

@@ -1,0 +1,22 @@
+"""Rule families.  Importing this package registers every family in
+:data:`tools.graftcheck.core.RULE_FAMILIES`:
+
+========== ================== ==========================================
+family     rules              guards
+========== ================== ==========================================
+locks      lock-order         one global lock order (deadlock freedom)
+           lock-blocking      no joins/sockets/subprocess/sleep/device
+                              dispatch while holding a lock
+           lock-shared-attr   shared state locked everywhere or nowhere
+tracer     jit-host-effect    no host side effects baked at trace time
+jit        jit-raw            every jit in the compile ledger
+           jit-closure        no function-identity cache defeats
+lifecycle  thread-lifecycle   threads daemonized or joined
+           handle-close       sockets/servers/files have a close path
+           wall-clock         monotonic clocks on deadline math
+phases     phase-taxonomy     host/device phase taxonomy in sync
+params     param-docs         config params documented + rendered
+========== ================== ==========================================
+"""
+
+from . import jit, lifecycle, locks, params, phases, tracer  # noqa: F401

@@ -1,0 +1,213 @@
+"""Thread/handle/clock lifecycle rules (family ``lifecycle``).
+
+- ``thread-lifecycle`` — every ``threading.Thread`` must be daemonized
+  (``daemon=True`` at construction) or joined somewhere in its module.
+  A non-daemon, never-joined thread keeps the interpreter alive after
+  ``main`` returns — the CLI "hang at exit" class, invisible in tests
+  that never exit the process.
+- ``handle-close`` — a socket / HTTP server / file handle stored on
+  ``self`` must have a close path in its class (``close`` /
+  ``server_close`` / ``shutdown`` on the same attribute); a local
+  ``open()`` outside a ``with`` must be ``close()``d in the same
+  function.  The serve/watchdog layers restart components (hot reload,
+  probe re-admission) — a leaked fd per cycle is a crash with a delay.
+- ``wall-clock`` — ``time.time()`` feeding arithmetic or comparison.
+  Deadline and staleness math must use the monotonic clock: the fleet
+  request deadline and the watchdog's heartbeat ages both die on
+  NTP/wall-clock steps.  Pure timestamping (``{"t": round(time.time(),
+  3)}``) is not flagged — epoch time is the right value to RECORD, and
+  the wrong value to SUBTRACT.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, Project, family
+from ..index import dotted, receiver_name
+
+_CLOSE_METHODS = {"close", "server_close", "shutdown", "stop"}
+
+
+def _has_kw_true(call: ast.Call, name: str) -> bool:
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is True:
+            return True
+    return False
+
+
+def _assigned_name(parents: Dict[ast.AST, ast.AST],
+                   call: ast.Call) -> Optional[str]:
+    """The attr/var a constructor result lands in, if any."""
+    p = parents.get(call)
+    if isinstance(p, ast.Assign) and len(p.targets) == 1:
+        t = p.targets[0]
+        if isinstance(t, ast.Attribute):
+            return t.attr
+        if isinstance(t, ast.Name):
+            return t.id
+    return None
+
+
+def _build_parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+@family("lifecycle")
+def check_lifecycle(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    findings += _check_threads(project)
+    findings += _check_handles(project)
+    findings += _check_wall_clock(project)
+    return findings
+
+
+# -- thread-lifecycle ----------------------------------------------------
+
+def _check_threads(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in project.modules:
+        parents = _build_parents(m.tree)
+        joined: Set[str] = set()
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "join":
+                r = receiver_name(node.func.value)
+                if r:
+                    joined.add(r)
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func) or ""
+            if d != "threading.Thread" and d.split(".")[-1] != "Thread":
+                continue
+            if d.split(".")[-1] == "Thread" and d != "threading.Thread" \
+                    and d != "Thread":
+                continue
+            if _has_kw_true(node, "daemon"):
+                continue
+            target = _assigned_name(parents, node)
+            if target is not None and target in joined:
+                continue
+            findings.append(Finding(
+                "thread-lifecycle", m.rel, node.lineno,
+                "Thread is neither daemon=True nor joined in this "
+                "module — a live non-daemon thread blocks interpreter "
+                "exit (and a crashed owner leaks it silently)"))
+    return findings
+
+
+# -- handle-close --------------------------------------------------------
+
+_HANDLE_KINDS = {"socket": "socket", "server": "HTTP server",
+                 "file": "file handle"}
+
+
+def _check_handles(project: Project) -> List[Finding]:
+    idx = project.index
+    findings: List[Finding] = []
+    for info in idx.classes.values():
+        if not info.handle_attrs:
+            continue
+        mod = project.module(info.module)
+        if mod is None:
+            continue
+        closed: Set[str] = set()
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _CLOSE_METHODS:
+                r = receiver_name(node.func.value)
+                if r:
+                    closed.add(r)
+        for attr, (kind, lineno) in sorted(info.handle_attrs.items()):
+            if attr not in closed:
+                findings.append(Finding(
+                    "handle-close", info.module, lineno,
+                    f"{info.name}.{attr} holds a {_HANDLE_KINDS[kind]} "
+                    f"with no close path in the class — restart/reload "
+                    f"cycles leak one per generation"))
+    # local open() outside `with`, never closed in the same function
+    for m in project.modules:
+        parents = _build_parents(m.tree)
+        for node in ast.walk(m.tree):
+            if not (isinstance(node, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))):
+                continue
+            closed = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in _CLOSE_METHODS:
+                    r = receiver_name(sub.func.value)
+                    if r:
+                        closed.add(r)
+            for sub in ast.walk(node):
+                if not (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Name)
+                        and sub.func.id == "open"):
+                    continue
+                p = parents.get(sub)
+                if isinstance(p, ast.withitem):
+                    continue
+                if isinstance(p, ast.Assign) and len(p.targets) == 1 \
+                        and isinstance(p.targets[0], ast.Attribute):
+                    continue   # self.X handles: the class-level check
+                name = _assigned_name(parents, sub)
+                if name is None or name in closed:
+                    continue
+                findings.append(Finding(
+                    "handle-close", m.rel, sub.lineno,
+                    f"open() result `{name}` has no close path in "
+                    f"`{node.name}` — use `with open(...)` or close it "
+                    f"on every exit path"))
+    return findings
+
+
+# -- wall-clock ----------------------------------------------------------
+
+def _check_wall_clock(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in project.modules:
+        parents = _build_parents(m.tree)
+        for node in ast.walk(m.tree):
+            if not (isinstance(node, ast.Call)
+                    and dotted(node.func) == "time.time"):
+                continue
+            if _feeds_math(node, parents):
+                findings.append(Finding(
+                    "wall-clock", m.rel, node.lineno,
+                    "time.time() feeds arithmetic/comparison — deadline "
+                    "and elapsed math must use time.monotonic() (or "
+                    "perf_counter); the wall clock steps under NTP and "
+                    "this computation steps with it"))
+    return findings
+
+
+def _feeds_math(call: ast.Call, parents: Dict[ast.AST, ast.AST]) -> bool:
+    p = parents.get(call)
+    if isinstance(p, (ast.BinOp, ast.Compare, ast.AugAssign, ast.UnaryOp)):
+        return True
+    # assigned to a name later used in arithmetic within the function
+    if isinstance(p, ast.Assign) and len(p.targets) == 1 \
+            and isinstance(p.targets[0], ast.Name):
+        name = p.targets[0].id
+        fn = p
+        while fn is not None and not isinstance(
+                fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+            fn = parents.get(fn)
+        if fn is None:
+            return False
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.BinOp, ast.Compare)):
+                for leaf in ast.walk(node):
+                    if isinstance(leaf, ast.Name) and leaf.id == name:
+                        return True
+    return False

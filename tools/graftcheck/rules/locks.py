@@ -1,0 +1,145 @@
+"""Lock-discipline rules (family ``locks``).
+
+- ``lock-order`` — two locks acquired in both orders anywhere in the
+  repo (lexically nested ``with`` blocks, plus lock acquisitions
+  reached through resolved calls made while a lock is held).  A
+  consistent global order is the only deadlock-freedom argument a
+  watchdog-less reader can check; one inversion is one interleaving
+  away from a frozen fleet.  Re-acquiring a non-reentrant lock you
+  already hold is reported under the same rule (self-deadlock).
+- ``lock-blocking`` — a blocking operation (thread join, socket I/O,
+  subprocess, ``time.sleep`` >= 10ms, ``Event.wait``, device dispatch
+  like ``predict_fn``/``warmup``) executed while holding a lock, either
+  directly or through a resolved call chain.  ``Condition.wait`` on the
+  held lock releases it and is never flagged.
+- ``lock-shared-attr`` — an attribute written under a lock at one site
+  but written bare at another: either the lock is load-bearing (the
+  bare site races) or it is theater (and the next reader will copy the
+  wrong pattern).  Constructors are exempt (happens-before publication),
+  as are functions whose every resolved call site runs under a lock and
+  helpers named ``*_locked``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from ..core import Finding, Project, family
+
+_INIT_NAMES = {"__init__", "__new__", "__post_init__", "__enter__"}
+
+
+def _short(key: str) -> str:
+    """mod::Class.attr -> Class.attr / mod::name -> mod:name for
+    messages."""
+    rel, _, rest = key.partition("::")
+    return rest if "." in rest else f"{rel.rsplit('/', 1)[-1]}:{rest}"
+
+
+@family("locks")
+def check_locks(project: Project) -> List[Finding]:
+    idx = project.index
+    findings: List[Finding] = []
+
+    # -- lock-order ------------------------------------------------------
+    # A bare threading.Condition() is backed by an RLock and reentrant;
+    # only plain Locks (including Conditions aliased onto one via
+    # Condition(self._lock)) self-deadlock on re-acquisition.
+    def _reentrant(key: str) -> bool:
+        return idx.lock_kind(key) != "Lock"
+
+    # edge (held, acquired) -> first example (module, line, via)
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    for fn in idx.funcs.values():
+        for key, line, held in fn.acquires:
+            for h in held:
+                if h != key:
+                    edges.setdefault((h, key), (fn.module, line, ""))
+                elif not _reentrant(key):
+                    findings.append(Finding(
+                        "lock-order", fn.module, line,
+                        f"{_short(key)} re-acquired while already held "
+                        f"— self-deadlock for a non-reentrant lock"))
+        for site in fn.calls:
+            if not site.held:
+                continue
+            callee = idx.resolve_call(site.node, fn.module, fn.cls,
+                                      fn.local_funcs)
+            if callee is None or callee not in idx.funcs:
+                continue
+            cfn = idx.funcs[callee]
+            for a in cfn.may_acquire:
+                for h in site.held:
+                    if h != a:
+                        edges.setdefault(
+                            (h, a), (fn.module, site.line,
+                                     f" via {cfn.name}()"))
+                    elif not _reentrant(a):
+                        findings.append(Finding(
+                            "lock-order", fn.module, site.line,
+                            f"call to {cfn.name}() re-acquires "
+                            f"{_short(a)} already held here — "
+                            f"self-deadlock for a non-reentrant lock"))
+    for (a, b), (mod, line, via) in sorted(edges.items()):
+        if (b, a) in edges and a < b:
+            mod2, line2, via2 = edges[(b, a)]
+            findings.append(Finding(
+                "lock-order", mod, line,
+                f"lock-order inversion: {_short(a)} -> {_short(b)} "
+                f"here{via}, but {_short(b)} -> {_short(a)} at "
+                f"{mod2}:{line2}{via2} — two threads taking the pair in "
+                f"opposite orders deadlock"))
+
+    # -- lock-blocking ---------------------------------------------------
+    for fn in idx.funcs.values():
+        for desc, line, held in fn.blocking:
+            if held:
+                findings.append(Finding(
+                    "lock-blocking", fn.module, line,
+                    f"{desc} while holding {_short(held[-1])} — every "
+                    f"other thread contending the lock stalls for the "
+                    f"full duration"))
+        for site in fn.calls:
+            if not site.held:
+                continue
+            callee = idx.resolve_call(site.node, fn.module, fn.cls,
+                                      fn.local_funcs)
+            if callee is None or callee not in idx.funcs:
+                continue
+            blk = idx.funcs[callee].may_block
+            if blk:
+                sample = sorted(blk)[0]
+                findings.append(Finding(
+                    "lock-blocking", fn.module, site.line,
+                    f"call to {idx.funcs[callee].name}() may block "
+                    f"({sample}) while holding {_short(site.held[-1])}"))
+
+    # -- lock-shared-attr ------------------------------------------------
+    # (class key, attr) -> {"held": [(mod,line)], "bare": [(mod,line)]}
+    writes: Dict[Tuple[str, str], Dict[str, List[Tuple[str, int]]]] = {}
+    for fn in idx.funcs.values():
+        if fn.is_init or fn.name in _INIT_NAMES:
+            continue
+        guarded_fn = idx.is_held_context(fn.fid)
+        for w in fn.attr_writes:
+            owner = w.owner
+            if owner is None:
+                continue
+            slot = writes.setdefault((owner, w.attr),
+                                     {"held": [], "bare": []})
+            if w.held or guarded_fn:
+                slot["held"].append((fn.module, w.line))
+            else:
+                slot["bare"].append((fn.module, w.line))
+    for (owner, attr), slot in sorted(writes.items()):
+        if not slot["held"] or not slot["bare"]:
+            continue
+        hmod, hline = slot["held"][0]
+        cls_name = owner.rpartition("::")[2]
+        for bmod, bline in slot["bare"]:
+            findings.append(Finding(
+                "lock-shared-attr", bmod, bline,
+                f"{cls_name}.{attr} written here with no lock, but "
+                f"written under a lock at {hmod}:{hline} — either this "
+                f"site races or the lock there is theater"))
+    return findings

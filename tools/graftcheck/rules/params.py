@@ -1,0 +1,97 @@
+"""Config/doc consistency rules (family ``params``).
+
+``param-docs`` — every key in ``config.py _DEFAULTS`` must carry a
+description in ``docs/_param_descriptions.py`` and render a row in
+``docs/Parameters.md``; every description key must still exist in
+``_DEFAULTS`` (aliases are documented on their canonical key).  PRs add
+parameters faster than they add prose — this rule is what keeps
+``docs/Parameters.md`` regen-complete instead of drifting one PR at a
+time.
+
+Everything is read statically (AST literals), so the rule never imports
+the package or jax.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Finding, Project, family
+
+
+def _dict_literal(tree: ast.AST, name: str
+                  ) -> Optional[Tuple[Dict[str, int], int]]:
+    """{key: lineno} of a module-level ``name = {...}`` dict literal,
+    plus the assignment's line."""
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):   # _DEFAULTS: Dict[...] = {
+            target = node.target
+        else:
+            continue
+        if isinstance(target, ast.Name) and target.id == name \
+                and isinstance(node.value, ast.Dict):
+            keys = {}
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value,
+                                                              str):
+                    keys[k.value] = k.lineno
+            return keys, node.lineno
+    return None
+
+
+@family("params")
+def check_params(project: Project) -> List[Finding]:
+    cfg_path = project.pkg / "config.py"
+    desc_path = project.root / "docs" / "_param_descriptions.py"
+    md_path = project.root / "docs" / "Parameters.md"
+    if not (cfg_path.exists() and desc_path.exists()):
+        return []   # fixture trees without a config surface
+    cfg_rel = cfg_path.relative_to(project.root).as_posix()
+    desc_rel = desc_path.relative_to(project.root).as_posix()
+    cfg_mod = project.module(cfg_rel)
+    cfg_tree = cfg_mod.tree if cfg_mod else ast.parse(
+        cfg_path.read_text())
+    defaults = _dict_literal(cfg_tree, "_DEFAULTS")
+    if defaults is None:
+        return [Finding("param-docs", cfg_rel, 1,
+                        "config.py no longer defines a _DEFAULTS dict "
+                        "literal — the parameter docs can't be audited")]
+    keys, _ = defaults
+    desc = _dict_literal(ast.parse(desc_path.read_text()), "DESC")
+    if desc is None:
+        return [Finding("param-docs", desc_rel, 1,
+                        "docs/_param_descriptions.py no longer defines a "
+                        "DESC dict literal")]
+    desc_keys, desc_line = desc
+    findings: List[Finding] = []
+    for key, lineno in sorted(keys.items()):
+        if key not in desc_keys:
+            findings.append(Finding(
+                "param-docs", cfg_rel, lineno,
+                f"param {key!r} has no description in "
+                f"docs/_param_descriptions.py — docs/Parameters.md "
+                f"renders an empty cell for it"))
+    for key, lineno in sorted(desc_keys.items()):
+        if key not in keys:
+            findings.append(Finding(
+                "param-docs", desc_rel, lineno,
+                f"description for {key!r} matches no _DEFAULTS key — "
+                f"stale, or an alias documented instead of its "
+                f"canonical key"))
+    if md_path.exists():
+        md = md_path.read_text()
+        for key, lineno in sorted(keys.items()):
+            if f"`{key}`" not in md:
+                findings.append(Finding(
+                    "param-docs", cfg_rel, lineno,
+                    f"param {key!r} is missing from docs/Parameters.md "
+                    f"— regenerate with `python docs/gen_parameters.py`"))
+    else:
+        findings.append(Finding(
+            "param-docs", cfg_rel, 1,
+            "docs/Parameters.md does not exist — regenerate with "
+            "`python docs/gen_parameters.py`"))
+    return findings

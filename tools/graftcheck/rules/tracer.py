@@ -1,0 +1,125 @@
+"""Jit tracer-safety rules (family ``tracer``).
+
+``jit-host-effect`` — a host side effect inside a function handed to a
+repo jit entry point (``@jax.jit`` / ``@instrumented_jit`` decorators,
+``jax.jit(f)`` / ``instrumented_jit(f)`` / ``CountingJit(f, ...)`` /
+``shard_map(f, ...)`` call forms).  Traced Python runs ONCE, at trace
+time: a registry counter bumps once and never again, ``time.*`` bakes
+the trace-time clock into the program as a constant, ``np.random``
+freezes one draw forever, ``.item()``/host casts force a device sync
+inside what should be an async dispatch, and ``nonlocal``/``global``
+mutation of closed-over state happens at trace time, not per call.
+Nothing crashes — the program silently computes something other than
+what the author meant, which is why this needs a static gate rather
+than a test.
+
+The scan is lexical (the jitted body plus its nested defs); helper
+calls out of the traced function are not followed — jitted helpers are
+themselves scanned at their own definition.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..core import Finding, Project, family
+from ..index import dotted
+
+# call forms whose function argument is traced
+_TRACING_CALLS = {"shard_map", "CountingJit", "instrumented_jit",
+                  "pallas_call", "vmap", "pmap", "scan", "while_loop",
+                  "fori_loop", "cond", "checkpoint", "remat", "grad",
+                  "value_and_grad"}
+
+# registry / gauge write surface (obs/registry.py and its re-exports)
+_REGISTRY_CALLS = {"inc", "set_gauge", "observe"}
+
+
+def _decorated_jit(node) -> bool:
+    for dec in node.decorator_list:
+        d = dotted(dec) or ""
+        if isinstance(dec, ast.Call):
+            d = dotted(dec.func) or ""
+            if d in ("functools.partial", "partial") and dec.args:
+                d = dotted(dec.args[0]) or ""
+        if d in ("jax.jit", "instrumented_jit", "obs.instrumented_jit") \
+                or d.endswith(".instrumented_jit"):
+            return True
+    return False
+
+
+def _collect_jitted(tree: ast.AST) -> List[ast.AST]:
+    """Function defs that are traced: jit-decorated, or passed (as the
+    first argument, or by name) into a tracing call form."""
+    jitted: List[ast.AST] = []
+    defs_by_name = {}
+    referenced: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, node)
+            if _decorated_jit(node):
+                jitted.append(node)
+        elif isinstance(node, ast.Call):
+            name = (node.func.attr if isinstance(node.func, ast.Attribute)
+                    else node.func.id if isinstance(node.func, ast.Name)
+                    else "")
+            d = dotted(node.func) or ""
+            if name in _TRACING_CALLS or d == "jax.jit":
+                for a in node.args[:1]:
+                    if isinstance(a, ast.Name):
+                        referenced.add(a.id)
+    for name in referenced:
+        node = defs_by_name.get(name)
+        if node is not None and node not in jitted:
+            jitted.append(node)
+    return jitted
+
+
+def _effect(node: ast.AST) -> Optional[str]:
+    if isinstance(node, (ast.Nonlocal, ast.Global)):
+        kw = "nonlocal" if isinstance(node, ast.Nonlocal) else "global"
+        return (f"`{kw}` mutation of closed-over state runs at trace "
+                f"time, once — not per call")
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Name):
+        if f.id == "print":
+            return "print() runs at trace time only"
+        return None
+    if not isinstance(f, ast.Attribute):
+        return None
+    d = dotted(f) or ""
+    root = d.split(".")[0]
+    if root == "time":
+        return (f"{d}() bakes the trace-time clock into the program as "
+                f"a constant")
+    if d.startswith(("np.random", "numpy.random", "random.")):
+        return f"{d}() freezes one host RNG draw into the program"
+    if f.attr == "item":
+        return (".item() forces a host sync / concretization inside a "
+                "traced function")
+    if root in ("obs", "registry", "REGISTRY") \
+            and f.attr in _REGISTRY_CALLS:
+        return (f"{d}() is a host-side registry write; under trace it "
+                f"fires once at compile time and never again")
+    if isinstance(f.value, ast.Name) and f.value.id == "self" \
+            and f.attr == "_inc":
+        return ("self._inc() is a registry write; under trace it fires "
+                "once at compile time and never again")
+    return None
+
+
+@family("tracer")
+def check_tracer(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in project.modules:
+        for fn in _collect_jitted(m.tree):
+            for node in ast.walk(fn):
+                msg = _effect(node)
+                if msg:
+                    findings.append(Finding(
+                        "jit-host-effect", m.rel, node.lineno,
+                        f"in jitted `{fn.name}`: {msg}"))
+    return findings

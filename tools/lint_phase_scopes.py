@@ -36,118 +36,38 @@ path, ``Serve::eject`` / ``Serve::probe`` in the health watchdog,
 serve/health.py) ride the same rule: declared in HOST_PHASES, used at
 their call sites, one unique ``phase_seconds_*`` series each.
 
-Runs standalone (``python tools/lint_phase_scopes.py``) and as a tier-1
-test (tests/test_phase_lint.py).  phases.py is loaded by file path so
-the lint never imports the package (or jax).
+Since the graftcheck suite landed, the implementation lives in
+``tools/graftcheck/rules/phases.py`` as the ``phases`` rule family and
+runs on the shared walker — one read+parse per file for ALL rule
+families instead of a private scan.  This entry point is preserved:
+``python tools/lint_phase_scopes.py`` (and tests/test_phase_lint.py)
+behave exactly as before; phases.py is loaded by file path so the lint
+never imports the package (or jax).
 """
 
 from __future__ import annotations
 
-import importlib.util
 import pathlib
-import re
 import sys
-from typing import Dict, List
+from typing import List
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 PKG = ROOT / "lightgbm_tpu"
 
-SCOPE_RE = re.compile(
-    r"(?:timetag\.scope|obs\.span|spans\.span"
-    r"|obs\.trace_span|obs\.trace_begin|tracing\.span|TRACER\.(?:span|begin)"
-    r")\(\s*[\"']([^\"']+)[\"']")
-NAMED_RE = re.compile(r"jax\.named_scope\(\s*[\"']([^\"']+)[\"']")
-SERIES_RE = re.compile(r"^phase_seconds_[a-z_][a-z0-9_]*$")
+sys.path.insert(0, str(ROOT))
 
-# the jitted paths carrying the device taxonomy: the growers plus the
-# compiled-forest inference program (serve/forest.py)
-DEVICE_FILES = ("ops/grow.py", "ops/ordered_grow.py", "serve/forest.py")
+from tools.graftcheck.rules import phases as _phases  # noqa: E402
 
-
-def _load_phases():
-    spec = importlib.util.spec_from_file_location(
-        "lightgbm_tpu_obs_phases", PKG / "obs" / "phases.py")
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
-def _scan(paths, rx) -> Dict[str, List[str]]:
-    found: Dict[str, List[str]] = {}
-    for p in paths:
-        if not p.exists():
-            # a missing device file shows up as its declared phases
-            # being unused — a diagnosable error, not a crash
-            continue
-        for m in rx.finditer(p.read_text()):
-            found.setdefault(m.group(1), []).append(
-                str(p.relative_to(ROOT)))
-    return found
+# the shared regexes/constants, re-exported for callers and tests
+SCOPE_RE = _phases.SCOPE_RE
+NAMED_RE = _phases.NAMED_RE
+SERIES_RE = _phases.SERIES_RE
+DEVICE_FILES = _phases.DEVICE_FILES
 
 
 def check() -> List[str]:
     """Return a list of violations (empty == clean)."""
-    phases = _load_phases()
-    errors: List[str] = []
-
-    # obs/ declares the taxonomy (docstrings mention the call forms); it
-    # is not a scope *user*
-    host_files = [p for p in sorted(PKG.rglob("*.py"))
-                  if "obs" not in p.relative_to(PKG).parts]
-    host_used = _scan(host_files, SCOPE_RE)
-    for name, sites in sorted(host_used.items()):
-        if name not in phases.HOST_PHASES:
-            errors.append(
-                f"timetag.scope({name!r}) in {sites} is not declared in "
-                f"obs/phases.py HOST_PHASES")
-    for name in sorted(phases.HOST_PHASES - set(host_used)):
-        errors.append(
-            f"HOST_PHASES declares {name!r} but no timetag.scope uses it")
-
-    dev_used = _scan([PKG / f for f in DEVICE_FILES], NAMED_RE)
-    for name, sites in sorted(dev_used.items()):
-        if name not in phases.DEVICE_PHASES:
-            errors.append(
-                f"jax.named_scope({name!r}) in {sites} is not declared in "
-                f"obs/phases.py DEVICE_PHASES")
-    for name in sorted(phases.DEVICE_PHASES - set(dev_used)):
-        errors.append(
-            f"DEVICE_PHASES declares {name!r} but no jax.named_scope in "
-            f"{DEVICE_FILES} uses it")
-
-    for name in sorted(phases.DEVICE_PHASES):
-        parent = phases.DEVICE_PARENT.get(name)
-        if parent is None:
-            errors.append(f"DEVICE_PARENT has no mapping for {name!r}")
-        elif parent not in phases.HOST_PHASES:
-            errors.append(
-                f"DEVICE_PARENT maps {name!r} -> {parent!r}, which is not "
-                f"a declared host phase")
-    covered = set(phases.DEVICE_PARENT.values())
-    for name in sorted(phases.JITTED_HOST_PHASES - covered):
-        errors.append(
-            f"jitted host phase {name!r} has no device phase mapped onto "
-            f"it — traces inside it would be unattributable")
-
-    # -- 4: phase taxonomy <-> metrics namespace (obs/spans.py) ---------
-    span_series = getattr(phases, "span_series", None)
-    if span_series is None:
-        errors.append("obs/phases.py no longer defines span_series() — "
-                      "the span/metrics namespace is unmapped")
-        return errors
-    seen: Dict[str, str] = {}
-    for name in sorted(phases.HOST_PHASES | phases.DEVICE_PHASES):
-        series = span_series(name)
-        if not SERIES_RE.match(series):
-            errors.append(
-                f"span_series({name!r}) = {series!r} is not a valid "
-                f"phase histogram series name ({SERIES_RE.pattern})")
-        if series in seen:
-            errors.append(
-                f"phases {seen[series]!r} and {name!r} collide onto the "
-                f"same span series {series!r}")
-        seen[series] = name
-    return errors
+    return _phases.scope_errors(ROOT, PKG)
 
 
 def main() -> int:
