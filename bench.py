@@ -298,7 +298,7 @@ def main() -> None:
     t_warm_warm = time.time() - t0
     warm_events = compile_ledger.events()[n_cold_events:]
 
-    print(json.dumps({
+    bench_json = {
         "metric": f"boosting_iters_per_sec_higgslike{num_data // 1000}k_"
                   "63leaves_255bins_binary",
         "value": round(iters_per_sec, 4),
@@ -310,7 +310,22 @@ def main() -> None:
         "warmup_warm_compiles": len(warm_events),
         "spread": [round(min(rates), 4), round(max(rates), 4)],
         "compile_events": compile_ledger.summary(5),
-    }))
+    }
+    # data-boundary bill (PR 13, io/guard.py): when a file-fed run
+    # quarantined rows, say so in the BENCH JSON — a throughput number
+    # from a partially-skipped dataset must carry its asterisk
+    # (bench_regress passes bad_rows through informationally)
+    from lightgbm_tpu import obs as _obs
+    _bad_total = _obs.get_counter("bad_rows_total")
+    if _bad_total:
+        _counters = _obs.snapshot()["counters"]
+        bench_json["bad_rows"] = {
+            "total": _bad_total,
+            **{k[len("bad_rows_"):]: v for k, v in sorted(
+                _counters.items())
+               if k.startswith("bad_rows_") and k != "bad_rows_total"},
+        }
+    print(json.dumps(bench_json))
     # trailing comment line only — the JSON line above is the contract.
     # LIGHTGBM_TPU_TIMETAG=1 folds the serializing per-phase breakdown in
     # so BENCH_*.json tails carry phase data; the obs counters are always

@@ -23,6 +23,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <functional>
+#include <limits>
 #include <string>
 #include <thread>
 #include <vector>
@@ -38,16 +39,42 @@ inline const char* skip_space(const char* p, const char* end) {
   return p;
 }
 
+// Case-insensitive match of [b, e) against one of the NA / infinity
+// spellings (the Python guard helpers' vocabulary, io/guard.py
+// NA_TOKENS) — anything else starting with an alpha char is a
+// *malformed* token, reported through first_bad_row so the guarded
+// Python path re-parses with full diagnostics.
+inline bool word_matches(const char* b, const char* e, const char* w) {
+  while (b < e && *w) {
+    if (std::tolower(static_cast<unsigned char>(*b)) != *w) return false;
+    ++b;
+    ++w;
+  }
+  return b == e && *w == '\0';
+}
+
+inline bool is_na_word(const char* b, const char* e) {
+  return word_matches(b, e, "na") || word_matches(b, e, "nan") ||
+         word_matches(b, e, "null") || word_matches(b, e, "none");
+}
+
+inline bool is_inf_word(const char* b, const char* e) {
+  return word_matches(b, e, "inf") || word_matches(b, e, "infinity");
+}
+
 inline double parse_double(const char* p, const char* end, const char** out) {
   p = skip_space(p, end);
+  const char* token_start = p;  // rewind point for degenerate tokens
   bool neg = false;
   if (p < end && (*p == '-' || *p == '+')) {
     neg = (*p == '-');
     ++p;
   }
   double value = 0.0;
+  bool consumed = false;
   while (p < end && *p >= '0' && *p <= '9') {
     value = value * 10.0 + (*p - '0');
+    consumed = true;
     ++p;
   }
   if (p < end && *p == '.') {
@@ -56,10 +83,12 @@ inline double parse_double(const char* p, const char* end, const char** out) {
     while (p < end && *p >= '0' && *p <= '9') {
       value += (*p - '0') * frac;
       frac *= 0.1;
+      consumed = true;
       ++p;
     }
   }
-  if (p < end && (*p == 'e' || *p == 'E')) {
+  if (consumed && p < end && (*p == 'e' || *p == 'E')) {
+    const char* exp_start = p;
     ++p;
     bool eneg = false;
     if (p < end && (*p == '-' || *p == '+')) {
@@ -67,33 +96,53 @@ inline double parse_double(const char* p, const char* end, const char** out) {
       ++p;
     }
     int ex = 0;
+    bool edigits = false;
     while (p < end && *p >= '0' && *p <= '9') {
       ex = ex * 10 + (*p - '0');
+      edigits = true;
       ++p;
     }
-    double scale = 1.0;
-    double base = 10.0;
-    int e = ex;
-    while (e) {               // pow10 by squaring
-      if (e & 1) scale *= base;
-      base *= base;
-      e >>= 1;
-    }
-    value = eneg ? value / scale : value * scale;
-  }
-  // Token spellings: na/nan/null -> 0.0 (matching the Python parser's
-  // missing-value mapping, parser.py _parse_delimited); inf parses as inf.
-  if (value == 0.0 && p < end &&
-      (*p == 'n' || *p == 'N' || *p == 'i' || *p == 'I')) {
-    if (p[0] == 'n' || p[0] == 'N') {
-      value = 0.0;
-      while (p < end && std::isalpha(static_cast<unsigned char>(*p))) ++p;
+    if (!edigits) {
+      // "1e" / "2e+": not an exponent — leave the 'e' unconsumed so
+      // the caller's whole-token check flags the row (Python
+      // float("1e") is a classified bad token; parity)
+      p = exp_start;
     } else {
-      value = std::strtod(p, nullptr);
-      while (p < end && std::isalpha(static_cast<unsigned char>(*p))) ++p;
+      double scale = 1.0;
+      double base = 10.0;
+      int e = ex;
+      while (e) {             // pow10 by squaring
+        if (e & 1) scale *= base;
+        base *= base;
+        e >>= 1;
+      }
+      value = eneg ? value / scale : value * scale;
     }
   }
-  *out = p;
+  // Word spellings: na/nan/null/none -> NaN (missing, the reference's
+  // NA semantics — io/guard.py feature_value mirrors this), inf /
+  // infinity -> inf.  Only the EXACT spellings consume; any other
+  // alpha run is left unconsumed so the callers' whole-token checks
+  // flag the row as malformed.
+  if (!consumed && p < end &&
+      (*p == 'n' || *p == 'N' || *p == 'i' || *p == 'I')) {
+    const char* w = p;
+    while (w < end && std::isalpha(static_cast<unsigned char>(*w))) ++w;
+    if (is_na_word(p, w)) {
+      value = std::numeric_limits<double>::quiet_NaN();
+      consumed = true;
+      p = w;
+    } else if (is_inf_word(p, w)) {
+      value = std::numeric_limits<double>::infinity();
+      consumed = true;
+      p = w;
+    }
+  }
+  // Degenerate tokens ("-", "+", ".", "-."): nothing numeric was
+  // consumed — rewind to the token start so the callers' whole-token
+  // checks see leftover chars and flag the row instead of accepting
+  // a phantom 0.0 (Python classifies these; parity).
+  *out = consumed ? p : token_start;
   return neg ? -value : value;
 }
 
@@ -194,10 +243,17 @@ extern "C" {
 // column split out.  Returns 0 on success.
 //   fmt_out: detected format (0 csv / 1 tsv / 2 libsvm)
 //   num_cols = feature columns (label excluded)
+//   first_bad_row_out: 1-based ordinal (among parsed data rows) of the
+//     first malformed row — unparseable token, ragged field count, or a
+//     bad LibSVM column index — or -1 when the file is clean.  The
+//     native loader only *flags* dirt; the Python wrapper re-parses
+//     flagged files through io/guard.py for classification, per-line
+//     diagnostics, and the fail-fast/quarantine policy.
 int lgbt_parse_file(const char* path, int has_header, int label_idx,
                     double** data_out, double** label_out,
                     int64_t* num_rows_out, int64_t* num_cols_out,
-                    int* fmt_out) {
+                    int* fmt_out, int64_t* first_bad_row_out) {
+  *first_bad_row_out = -1;
   FILE* fh = fopen(path, "rb");
   if (!fh) return 1;
   fseek(fh, 0, SEEK_END);
@@ -241,21 +297,39 @@ int lgbt_parse_file(const char* path, int has_header, int label_idx,
         // A first token containing ':' is an index:value pair — the row has
         // no label (standard predict-time LibSVM; parser.py:67-71).
         if (!first_token_has_colon(p, e)) {
-          parse_double(p, e, &q);  // skip label
-          p = q;
+          const char* tok = skip_space(p, e);
+          const char* tok_end = tok;
+          while (tok_end < e && *tok_end != ' ' && *tok_end != '\t')
+            ++tok_end;
+          parse_double(tok, tok_end, &q);  // skip label
+          p = tok_end;
         }
         while (p < e) {
           p = skip_space(p, e);
           if (p >= e) break;
-          long k = parse_long(p, e, &q);
-          if (q < e && *q == ':') {
-            if (k > local) local = k;
-            p = q + 1;
-            parse_double(p, e, &q);
-            p = q;
-          } else {
-            p = q < e ? q + 1 : e;
+          const char* tok_end = p;
+          while (tok_end < e && *tok_end != ' ' && *tok_end != '\t')
+            ++tok_end;
+          // Only a FULLY valid digits:value token may raise the column
+          // count — a malformed row must not inflate the matrix
+          // allocation (the fill pass flags it for the Python path).
+          const char* d = p;
+          long k = 0;
+          bool digits = false;
+          while (d < tok_end && *d >= '0' && *d <= '9') {
+            k = k * 10 + (*d - '0');
+            digits = true;
+            ++d;
+            if (k > (1L << 31)) {  // absurd index: corrupt, not a column
+              digits = false;
+              break;
+            }
           }
+          if (digits && d < tok_end && *d == ':' && d + 1 < tok_end) {
+            parse_double(d + 1, tok_end, &q);
+            if (q == tok_end && k > local) local = k;
+          }
+          p = tok_end;
         }
       }
       int64_t cur = max_idx.load();
@@ -285,6 +359,22 @@ int lgbt_parse_file(const char* path, int has_header, int label_idx,
   // label_idx < 0 means "no label column": leave labels at zero
   memset(label, 0, sizeof(double) * nrows);
 
+  // first malformed data row (1-based ordinal), min across threads
+  std::atomic<int64_t> first_bad{-1};
+  auto flag_bad = [&first_bad](int64_t r) {
+    int64_t ord = r + 1;
+    int64_t cur = first_bad.load();
+    while ((cur < 0 || ord < cur) &&
+           !first_bad.compare_exchange_weak(cur, ord)) {
+    }
+  };
+  // A numeric token must consume its WHOLE field — leftover chars
+  // (after trailing spaces) mean garbage like "1.5x" or "abc".
+  auto fully_parsed = [](const char* q, const char* fe) {
+    q = skip_space(q, fe);
+    return q == fe;
+  };
+
   if (fmt == 2) {
     parallel_for(nrows, [&](int64_t lo, int64_t hi) {
       for (int64_t r = lo; r < hi; ++r) {
@@ -293,28 +383,57 @@ int lgbt_parse_file(const char* path, int has_header, int label_idx,
         double* row = data + r * ncols;
         memset(row, 0, sizeof(double) * ncols);
         const char* q;
+        bool bad = false;
         if (first_token_has_colon(p, e)) {
           label[r] = 0.0;  // label-less row (predict-time LibSVM)
         } else {
-          label[r] = parse_double(p, e, &q);
-          p = q;
+          const char* tok = skip_space(p, e);
+          const char* tok_end = tok;
+          while (tok_end < e && *tok_end != ' ' && *tok_end != '\t')
+            ++tok_end;
+          label[r] = parse_double(tok, tok_end, &q);
+          bad = bad || (tok_end > tok && q != tok_end);
+          p = tok_end;
         }
-        while (p < e) {
+        while (p < e && !bad) {
           p = skip_space(p, e);
           if (p >= e) break;
-          long k = parse_long(p, e, &q);
-          if (q < e && *q == ':') {
-            p = q + 1;
-            double v = parse_double(p, e, &q);
-            if (k >= 0 && k < ncols) row[k] = v;
-            p = q;
-          } else {
-            p = q < e ? q + 1 : e;
+          const char* tok_end = p;
+          while (tok_end < e && *tok_end != ' ' && *tok_end != '\t')
+            ++tok_end;
+          // index: one or more bare digits (a leading '-' is the
+          // negative-column corruption the guard classifies)
+          const char* d = p;
+          long k = 0;
+          bool digits = false;
+          while (d < tok_end && *d >= '0' && *d <= '9') {
+            k = k * 10 + (*d - '0');
+            digits = true;
+            ++d;
+            if (k > (1L << 31)) {  // absurd index: corrupt, not a column
+              digits = false;
+              break;
+            }
           }
+          if (!digits || d >= tok_end || *d != ':' || k >= ncols) {
+            bad = true;
+            break;
+          }
+          double v = parse_double(d + 1, tok_end, &q);
+          if (d + 1 == tok_end || q != tok_end) {
+            bad = true;  // empty or partially-consumed value token
+            break;
+          }
+          row[k] = v;
+          p = tok_end;
         }
+        if (bad) flag_bad(r);
       }
     });
   } else {
+    // expected field count: from the first data line (the probe above)
+    const int64_t fields_expected =
+        ncols + (label_idx >= 0 ? 1 : 0);
     parallel_for(nrows, [&](int64_t lo, int64_t hi) {
       for (int64_t r = lo; r < hi; ++r) {
         const char* p = idx.begin[first_row + r];
@@ -322,12 +441,21 @@ int lgbt_parse_file(const char* path, int has_header, int label_idx,
         double* row = data + r * ncols;
         int64_t col = 0;       // column in file incl. label position
         int64_t feat = 0;      // feature column
-        while (p <= e && col <= ncols) {
+        bool bad = false;
+        while (p <= e) {
           const char* field_end = static_cast<const char*>(
               memchr(p, delim, static_cast<size_t>(e - p)));
           if (!field_end) field_end = e;
+          const char* fs = skip_space(p, field_end);
           const char* q;
-          double v = parse_double(p, field_end, &q);
+          double v;
+          if (fs == field_end) {
+            // empty field: missing value (io/guard.py feature_value)
+            v = std::numeric_limits<double>::quiet_NaN();
+          } else {
+            v = parse_double(fs, field_end, &q);
+            if (!fully_parsed(q, field_end)) bad = true;
+          }
           if (col == label_idx) {
             label[r] = v;
           } else if (feat < ncols) {
@@ -337,7 +465,9 @@ int lgbt_parse_file(const char* path, int has_header, int label_idx,
           p = field_end + 1;
           if (field_end == e) break;
         }
+        if (col != fields_expected) bad = true;  // ragged row
         while (feat < ncols) row[feat++] = 0.0;
+        if (bad) flag_bad(r);
       }
     });
   }
@@ -346,6 +476,7 @@ int lgbt_parse_file(const char* path, int has_header, int label_idx,
   *label_out = label;
   *num_rows_out = nrows;
   *num_cols_out = ncols;
+  *first_bad_row_out = first_bad.load();
   return 0;
 }
 

@@ -70,6 +70,13 @@ DESC = {
                         "model per slot after each successful reload; a "
                         "restarted server boots it instead of "
                         "input_model (crash restore)",
+    "serve_max_body_bytes": "task=serve: request body size cap — larger "
+                            "payloads are shed with 413 before any "
+                            "parsing or device time (0 = no cap)",
+    "serve_nonfinite_policy": "reject | propagate — NaN/Inf feature "
+                              "values in /predict payloads either 400 "
+                              "naming the offending row, or pass "
+                              "through to the forest",
     "events_file": "per-iteration JSONL telemetry stream path "
                    "(docs/OBSERVABILITY.md; --events-file on the CLI)",
     "trace_dir": "device trace output dir; LIGHTGBM_TPU_TRACE_DIR env "
@@ -198,6 +205,16 @@ DESC = {
     "snapshot_keep": "newest snapshot files retained (0 = keep all)",
     "nan_policy": "none | fail_fast | skip_tree — non-finite "
                   "gradient/score containment",
+    "bad_data_policy": "fail_fast | quarantine — malformed input rows at "
+                       "file load either raise a LightGBMError naming "
+                       "file:line + token, or are skipped into "
+                       "<data>.quarantine under the error budget "
+                       "(docs/FAULT_TOLERANCE.md §Data boundary)",
+    "max_bad_rows": "absolute quarantine budget: abort the load after "
+                    "this many bad rows (0 = no absolute cap)",
+    "max_bad_row_fraction": "relative quarantine budget: abort when bad "
+                            "rows exceed this fraction of rows seen "
+                            "(0 = no fractional cap)",
     "distributed_init_retries": "coordinator-connect retries with "
                                 "exponential backoff",
     "distributed_init_backoff": "first coordinator-connect retry delay, "

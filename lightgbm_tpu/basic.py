@@ -92,6 +92,7 @@ class Dataset:
         streamed = None
         file_roles = None
         file_label_idx = 0
+        file_guard = None
         if isinstance(data, str):
             cfg_probe = Config({**self.params, "task": "train"})
             # In-data column roles (dataset_loader.cpp SetHeader, :22-157):
@@ -143,6 +144,7 @@ class Dataset:
                             cat_idx_stream.append(names.index(c))
                         else:
                             cat_idx_stream.append(int(c))
+                from .io.guard import IngestGuard
                 from .io.streaming import load_file_two_round
                 if file_roles is not None:
                     cat_idx_stream = sorted(set(cat_idx_stream)
@@ -150,6 +152,12 @@ class Dataset:
                 streamed = load_file_two_round(
                     data, has_header=cfg_probe.has_header,
                     label_idx=file_label_idx,
+                    guard=IngestGuard(
+                        data,
+                        policy=str(cfg_probe.bad_data_policy),
+                        max_bad_rows=int(cfg_probe.max_bad_rows),
+                        max_bad_row_fraction=float(
+                            cfg_probe.max_bad_row_fraction)),
                     max_bin=int(self.params.get("max_bin", self.max_bin)),
                     min_data_in_bin=cfg_probe.min_data_in_bin,
                     min_data_in_leaf=cfg_probe.min_data_in_leaf,
@@ -165,10 +173,18 @@ class Dataset:
                     reference=ref)
                 data = None
             else:
+                from .io.guard import IngestGuard
+                file_guard = IngestGuard(
+                    data,
+                    policy=str(cfg_probe.bad_data_policy),
+                    max_bad_rows=int(cfg_probe.max_bad_rows),
+                    max_bad_row_fraction=float(
+                        cfg_probe.max_bad_row_fraction))
                 label, X, header = parse_file(
                     data,
                     has_header=cfg_probe.has_header,
-                    label_idx=file_label_idx)
+                    label_idx=file_label_idx,
+                    guard=file_guard)
                 if self.label is None:
                     self.label = label
                 if header and self.feature_name == "auto":
@@ -234,7 +250,13 @@ class Dataset:
         if self.init_score is not None:
             md.set_init_score(np.asarray(self.init_score))
         if isinstance(self.data, str) and streamed is None:
-            # the streaming loader already side-loaded .weight/.query/.init
+            # the streaming loader already side-loaded .weight/.query/.init;
+            # quarantined rows make positional side files un-alignable —
+            # named refusal, not silent misalignment
+            if file_guard is not None:
+                from .io.guard import check_side_files_alignment
+                check_side_files_alignment(self.data,
+                                           file_guard.bad_total)
             md.load_side_files(self.data)
             if file_roles is not None and data is not None:
                 # in-data weight/group columns override side files
@@ -259,24 +281,41 @@ class Dataset:
             # (reference _set_predictor flow, dataset_loader.cpp:10)
             if streamed is not None:
                 # chunked predict: never materialize the full float matrix
-                from .io.streaming import _data_lines, _parse_chunk, \
-                    _probe_format
+                from .io.guard import IngestGuard
+                from .io.streaming import (_numbered_data_lines,
+                                           _parse_chunk, _probe_format)
                 path = self.data
                 has_h = bool(self.params.get("has_header", False))
                 fmt = _probe_format(path, has_h)
                 nf = streamed.num_total_features if fmt == "libsvm" else None
                 lbl_idx = int(self.params.get("label_column", 0) or 0)
+                # shadow guard: the two-round load above already
+                # classified (and counted) this file's bad rows — this
+                # re-read must make the SAME skip decisions so the init
+                # scores align with the binned rows, without
+                # double-counting bad_rows_* or rewriting the sink
+                shadow = IngestGuard(
+                    path,
+                    policy=str(self.params.get("bad_data_policy",
+                                               "fail_fast")),
+                    record=False)
                 chunks = []
                 buf: List[str] = []
-                for line in _data_lines(path, has_h):
+                nums: List[int] = []
+                for lineno, line in _numbered_data_lines(path, has_h):
                     buf.append(line)
+                    nums.append(lineno)
                     if len(buf) >= 262144:
-                        _, Xc = _parse_chunk(buf, fmt, lbl_idx, nf)
+                        _, Xc = _parse_chunk(buf, fmt, lbl_idx, nf,
+                                             guard=shadow,
+                                             line_numbers=nums)
                         chunks.append(np.asarray(
                             self._predictor.predict(Xc, raw_score=True)))
                         buf = []
+                        nums = []
                 if buf:
-                    _, Xc = _parse_chunk(buf, fmt, lbl_idx, nf)
+                    _, Xc = _parse_chunk(buf, fmt, lbl_idx, nf,
+                                         guard=shadow, line_numbers=nums)
                     chunks.append(np.asarray(
                         self._predictor.predict(Xc, raw_score=True)))
                 raw = np.concatenate(chunks, axis=0)

@@ -317,9 +317,14 @@ def LGBM_DatasetCreateFromFile(filename, parameters, reference, out):
     else:
         # alias-resolved config ('header=' -> has_header etc., config.py)
         cfg = _dataset_params(params)
+        from ..io.guard import IngestGuard
         label, X, header = parse_file(
             path, has_header=bool(cfg.has_header),
-            label_idx=int(cfg.label_column or 0))
+            label_idx=int(cfg.label_column or 0),
+            guard=IngestGuard(
+                path, policy=str(cfg.bad_data_policy),
+                max_bad_rows=int(cfg.max_bad_rows),
+                max_bad_row_fraction=float(cfg.max_bad_row_fraction)))
         binned = _binned_from_matrix(X, params, ref)
         if label is not None:
             binned.metadata.set_label(label)

@@ -200,6 +200,12 @@ _DEFAULTS: Dict[str, Any] = {
     "snapshot_dir": "",        # where snapshots live; also enables resume
     "snapshot_keep": 3,        # newest files retained (0 = keep all)
     "nan_policy": "none",      # none | fail_fast | skip_tree
+    # data boundary (io/guard.py; docs/FAULT_TOLERANCE.md §Data boundary)
+    "bad_data_policy": "fail_fast",  # fail_fast | quarantine malformed
+                                     # input rows at file load
+    "max_bad_rows": 0,         # absolute quarantine budget (0 = no cap)
+    "max_bad_row_fraction": 0.1,  # relative quarantine budget over rows
+                                  # seen (0 = no cap)
     "distributed_init_retries": 3,    # coordinator-connect retries
     "distributed_init_backoff": 2.0,  # first retry delay, seconds (x2 each)
     # distributed fault tolerance (parallel/watchdog.py,
@@ -231,6 +237,10 @@ _DEFAULTS: Dict[str, Any] = {
     "serve_stall_ms": 5000.0,   # device-batch stall age -> replica wedged
     "serve_latency_outlier": 8.0,  # EWMA multiple of fleet median -> suspect
     "serve_state_file": "",     # last-good model state JSON (crash restore)
+    # serve ingress hardening (serve/server.py; docs/FAULT_TOLERANCE.md)
+    "serve_max_body_bytes": 33554432,  # request body cap -> 413 (0 = none)
+    "serve_nonfinite_policy": "reject",  # reject | propagate NaN/Inf
+                                         # feature values in requests
     # observability (lightgbm_tpu/obs/; docs/OBSERVABILITY.md)
     "events_file": "",         # per-iteration JSONL event stream path
     "trace_dir": "",           # device trace dir (LIGHTGBM_TPU_TRACE_DIR wins)
@@ -388,6 +398,24 @@ class Config:
                 "(expected none, fail_fast, or skip_tree)")
         if v["snapshot_freq"] < 0:
             raise ValueError("snapshot_freq must be >= 0")
+        if v["bad_data_policy"] not in ("fail_fast", "quarantine"):
+            raise ValueError(
+                f"Unknown bad_data_policy {v['bad_data_policy']} "
+                "(expected fail_fast or quarantine)")
+        if v["max_bad_rows"] < 0:
+            raise ValueError("max_bad_rows must be >= 0 (0 = no absolute "
+                             "quarantine budget)")
+        if not (0.0 <= v["max_bad_row_fraction"] <= 1.0):
+            raise ValueError("max_bad_row_fraction must be in [0, 1] "
+                             "(0 = no fractional quarantine budget)")
+        if v["serve_max_body_bytes"] < 0:
+            raise ValueError("serve_max_body_bytes must be >= 0 "
+                             "(0 = no request body cap)")
+        if v["serve_nonfinite_policy"] not in ("reject", "propagate"):
+            raise ValueError(
+                f"Unknown serve_nonfinite_policy "
+                f"{v['serve_nonfinite_policy']} "
+                "(expected reject or propagate)")
         if v["distributed_heartbeat_ms"] < 0:
             raise ValueError("distributed_heartbeat_ms must be >= 0 "
                              "(0 disables the collective watchdog)")

@@ -1,3 +1,4 @@
 from .binning import BinMapper, CATEGORICAL, NUMERICAL  # noqa: F401
 from .dataset import BinnedDataset, Metadata  # noqa: F401
+from .guard import IngestGuard, read_quarantine  # noqa: F401
 from .parser import detect_format, parse_file  # noqa: F401

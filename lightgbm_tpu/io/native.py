@@ -153,7 +153,7 @@ def _load_or_build() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
         ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
-        ctypes.POINTER(ctypes.c_int)]
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int64)]
     lib.lgbt_free.restype = None
     lib.lgbt_free.argtypes = [ctypes.c_void_p]
     lib.lgbt_values_to_bins.restype = None
@@ -170,8 +170,14 @@ _FMT_NAMES = {0: "csv", 1: "tsv", 2: "libsvm"}
 
 def parse_file_native(path: str, has_header: bool = False,
                       label_idx: int = 0
-                      ) -> Optional[Tuple[np.ndarray, np.ndarray, str]]:
-    """Parse with the C++ loader; returns (label, X, fmt) or None."""
+                      ) -> Optional[Tuple[np.ndarray, np.ndarray, str, int]]:
+    """Parse with the C++ loader; returns (label, X, fmt,
+    first_bad_row) or None.  ``first_bad_row`` is the 1-based ordinal
+    (among parsed data rows) of the first malformed row the loader saw,
+    or -1 for a clean file — callers holding a flagged result must
+    discard it and re-parse through the guarded Python path
+    (io/parser.py), which owns classification, per-line diagnostics,
+    and the fail-fast/quarantine policy."""
     lib = get_lib()
     if lib is None:
         return None
@@ -180,10 +186,11 @@ def parse_file_native(path: str, has_header: bool = False,
     nrows = ctypes.c_int64()
     ncols = ctypes.c_int64()
     fmt = ctypes.c_int()
+    bad_row = ctypes.c_int64()
     rc = lib.lgbt_parse_file(path.encode(), int(has_header), int(label_idx),
                              ctypes.byref(data_p), ctypes.byref(label_p),
                              ctypes.byref(nrows), ctypes.byref(ncols),
-                             ctypes.byref(fmt))
+                             ctypes.byref(fmt), ctypes.byref(bad_row))
     if rc != 0:
         return None
     n, f = nrows.value, ncols.value
@@ -193,7 +200,7 @@ def parse_file_native(path: str, has_header: bool = False,
     finally:
         lib.lgbt_free(data_p)
         lib.lgbt_free(label_p)
-    return y, X, _FMT_NAMES.get(fmt.value, "csv")
+    return y, X, _FMT_NAMES.get(fmt.value, "csv"), int(bad_row.value)
 
 
 def values_to_bins_native(values: np.ndarray, upper_bounds: np.ndarray,

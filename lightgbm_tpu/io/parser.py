@@ -5,13 +5,38 @@ from delimiter statistics of the first lines (parser.cpp:10-72), the label
 column defaults to column 0, and rows are produced as sparse (col, value)
 pairs.  This implementation is vectorized NumPy rather than a line-by-line
 state machine.
+
+Malformed input is contained, never crashed on (docs/FAULT_TOLERANCE.md
+§Data boundary): every token conversion goes through the
+``io/guard.py`` helpers (NA/empty -> NaN missing values, matching the
+reference's NA handling), and every bad line — unparseable token,
+ragged row, bad LibSVM column index, empty row — is classified and
+routed through a per-file :class:`~.guard.IngestGuard`, which either
+raises a ``LightGBMError`` naming ``file:line`` and the offending token
+(``bad_data_policy=fail_fast``) or skips the row under an error budget,
+writing it to ``<data>.quarantine`` (``bad_data_policy=quarantine``).
+Blank lines are never data: they are skipped without counting toward
+chunk sizes, so chunked prediction output stays aligned with input row
+numbers.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from .guard import IngestGuard, column_index, feature_value
+
+
+class _BadLine(Exception):
+    """Internal: one classified bad line (reason, detail) — converted to
+    the guard's verdict (raise or skip) at the per-line loop."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(f"{reason}: {detail}")
+        self.reason = reason
+        self.detail = detail
 
 
 def detect_format(lines: List[str]) -> str:
@@ -30,19 +55,52 @@ def detect_format(lines: List[str]) -> str:
     return "csv"
 
 
-def _parse_delimited(lines: List[str], delim: str, label_idx: int
+def _line_no(line_numbers: Optional[Sequence[int]], i: int) -> int:
+    return int(line_numbers[i]) if line_numbers is not None else i + 1
+
+
+def _parse_delimited(lines: List[str], delim: str, label_idx: int,
+                     guard: Optional[IngestGuard] = None,
+                     line_numbers: Optional[Sequence[int]] = None
                      ) -> Tuple[np.ndarray, np.ndarray]:
-    rows = []
-    for line in lines:
+    g = guard if guard is not None else IngestGuard("<data>")
+    rows: List[List[float]] = []
+    for i, line in enumerate(lines):
         line = line.strip()
         if not line:
             continue
         parts = line.split(delim)
-        rows.append([float(p) if p not in ("", "na", "nan", "NA", "NaN", "null") else 0.0
-                     for p in parts])
+        if all(not p.strip() for p in parts):
+            g.bad_row(_line_no(line_numbers, i), line, "empty",
+                      "row has no fields")
+            continue
+        expected = g.expect_fields(len(parts))
+        if len(parts) != expected:
+            g.bad_row(_line_no(line_numbers, i), line, "ragged_row",
+                      f"{len(parts)} fields where the file has "
+                      f"{expected}")
+            continue
+        vals: List[float] = []
+        bad_tok: Optional[str] = None
+        for p in parts:
+            try:
+                vals.append(feature_value(p))
+            except ValueError:
+                bad_tok = p
+                break
+        if bad_tok is not None:
+            g.bad_row(_line_no(line_numbers, i), line,
+                      "unparseable_token", f"token {bad_tok!r}")
+            continue
+        rows.append(vals)
+        g.good_rows(1)
     mat = np.asarray(rows, dtype=np.float64)
     if mat.size == 0:
         return np.zeros((0,)), np.zeros((0, 0))
+    if label_idx >= mat.shape[1]:
+        from ..utils import log
+        log.fatal("label column index %d out of range (file rows have "
+                  "%d fields)", label_idx, mat.shape[1])
     if label_idx >= 0:
         label = mat[:, label_idx]
         feats = np.delete(mat, label_idx, axis=1)
@@ -52,41 +110,94 @@ def _parse_delimited(lines: List[str], delim: str, label_idx: int
     return label, feats
 
 
-def _parse_libsvm(lines: List[str], num_features: Optional[int] = None
+def _parse_libsvm(lines: List[str], num_features: Optional[int] = None,
+                  guard: Optional[IngestGuard] = None,
+                  line_numbers: Optional[Sequence[int]] = None
                   ) -> Tuple[np.ndarray, np.ndarray]:
-    labels = []
-    entries = []  # (row, col, value)
+    g = guard if guard is not None else IngestGuard("<data>")
+    labels: List[float] = []
+    entries: List[Tuple[int, int, float]] = []  # (row, col, value)
     max_col = -1
     row = 0
-    for line in lines:
+    for i, line in enumerate(lines):
         line = line.strip()
         if not line:
             continue
         parts = line.split()
-        start = 0
-        if ":" not in parts[0]:
-            labels.append(float(parts[0]))
-            start = 1
-        else:
-            labels.append(0.0)
-        for tok in parts[start:]:
-            col_s, val_s = tok.split(":", 1)
-            col = int(col_s)
+        try:
+            lab = 0.0
+            start = 0
+            if ":" not in parts[0]:
+                try:
+                    lab = feature_value(parts[0])
+                except ValueError:
+                    raise _BadLine("unparseable_token",
+                                   f"label token {parts[0]!r}")
+                start = 1
+            row_entries: List[Tuple[int, float]] = []
+            for tok in parts[start:]:
+                col_s, sep, val_s = tok.partition(":")
+                if not sep:
+                    raise _BadLine("unparseable_token",
+                                   f"token {tok!r} is not index:value")
+                try:
+                    col = column_index(col_s)
+                except ValueError:
+                    raise _BadLine("bad_column_index",
+                                   f"column index {col_s!r} in token "
+                                   f"{tok!r}")
+                if num_features is not None and col >= num_features:
+                    raise _BadLine(
+                        "bad_column_index",
+                        f"column index {col} out of range (file has "
+                        f"{num_features} feature columns) in token "
+                        f"{tok!r}")
+                try:
+                    val = feature_value(val_s)
+                except ValueError:
+                    raise _BadLine("unparseable_token",
+                                   f"value {val_s!r} in token {tok!r}")
+                row_entries.append((col, val))
+        except _BadLine as bl:
+            g.bad_row(_line_no(line_numbers, i), line, bl.reason,
+                      bl.detail)
+            continue
+        labels.append(lab)
+        for col, val in row_entries:
             max_col = max(max_col, col)
-            entries.append((row, col, float(val_s)))
+            entries.append((row, col, val))
         row += 1
+        g.good_rows(1)
     ncol = num_features if num_features is not None else max_col + 1
     feats = np.zeros((row, max(ncol, 0)), dtype=np.float64)
     for r, c, v in entries:
-        if c < feats.shape[1]:
-            feats[r, c] = v
+        feats[r, c] = v
     return np.asarray(labels, dtype=np.float64), feats
+
+
+def _numbered_lines(path: str, has_header: bool
+                    ) -> Iterator[Tuple[int, str]]:
+    """Yield (1-based physical line number, raw line) for every
+    non-blank data line; the header line is consumed, blank lines are
+    skipped.  Undecodable bytes are replaced (the replacement chars then
+    fail token parsing and get *classified* instead of killing the read
+    with a UnicodeDecodeError)."""
+    with open(path, "r", errors="replace") as fh:
+        lineno = 0
+        if has_header:
+            fh.readline()
+            lineno = 1
+        for line in fh:
+            lineno += 1
+            if line.strip():
+                yield lineno, line
 
 
 def parse_file_chunks(path: str, has_header: bool = False,
                       label_idx: int = 0,
                       num_features: Optional[int] = None,
-                      chunk_rows: int = 1 << 16):
+                      chunk_rows: int = 1 << 16,
+                      guard: Optional[IngestGuard] = None):
     """Yield (label, features) chunks of at most ``chunk_rows`` rows.
 
     The streaming analogue of parse_file for O(chunk)-memory prediction
@@ -94,36 +205,50 @@ def parse_file_chunks(path: str, has_header: bool = False,
     ReadAllAndProcessParallel pipeline, reference
     src/application/predictor.hpp:81-129).  The format is detected from
     the first chunk; LibSVM chunks are densified to ``num_features``
-    columns so chunk widths agree."""
-    with open(path, "r") as fh:
-        header_line = fh.readline() if has_header else None
-        probe: List[str] = []
-        fmt: Optional[str] = None
-        chunk: List[str] = []
-        for line in fh:
-            if fmt is None and len(probe) < 32:
-                if line.strip():
-                    probe.append(line)
-            chunk.append(line)
-            if len(chunk) >= chunk_rows:
-                if fmt is None:
-                    fmt = detect_format(probe)
-                yield _parse_chunk(chunk, fmt, label_idx, num_features)
-                chunk = []
-        if chunk:
+    columns so chunk widths agree.  Blank lines are skipped without
+    counting toward ``chunk_rows`` — they are skipped by the parser too,
+    so counting them would silently misalign chunked prediction rows
+    against input line numbers.  ``guard`` defaults to a fail-fast
+    :class:`IngestGuard` on ``path`` (prediction outputs are positional;
+    silently skipping rows would misalign them — quarantine is a
+    training-side policy)."""
+    g = guard if guard is not None else IngestGuard(path)
+    probe: List[str] = []
+    fmt: Optional[str] = None
+    chunk: List[str] = []
+    nums: List[int] = []
+    for lineno, line in _numbered_lines(path, has_header):
+        if fmt is None and len(probe) < 32:
+            probe.append(line)
+        chunk.append(line)
+        nums.append(lineno)
+        if len(chunk) >= chunk_rows:
             if fmt is None:
                 fmt = detect_format(probe)
-            yield _parse_chunk(chunk, fmt, label_idx, num_features)
-    _ = header_line
+            yield _parse_chunk(chunk, fmt, label_idx, num_features,
+                               guard=g, line_numbers=nums)
+            chunk = []
+            nums = []
+    if chunk:
+        if fmt is None:
+            fmt = detect_format(probe)
+        yield _parse_chunk(chunk, fmt, label_idx, num_features,
+                           guard=g, line_numbers=nums)
+    g.finish()
 
 
 def _parse_chunk(lines: List[str], fmt: str, label_idx: int,
-                 num_features: Optional[int]):
+                 num_features: Optional[int],
+                 guard: Optional[IngestGuard] = None,
+                 line_numbers: Optional[Sequence[int]] = None):
     if fmt == "libsvm":
-        label, feats = _parse_libsvm(lines, num_features)
+        label, feats = _parse_libsvm(lines, num_features, guard=guard,
+                                     line_numbers=line_numbers)
     else:
         delim = "," if fmt == "csv" else "\t"
-        label, feats = _parse_delimited(lines, delim, label_idx)
+        label, feats = _parse_delimited(lines, delim, label_idx,
+                                        guard=guard,
+                                        line_numbers=line_numbers)
     if num_features is not None and feats.ndim == 2 \
             and feats.shape[1] != num_features:
         fixed = np.zeros((feats.shape[0], num_features), np.float64)
@@ -134,21 +259,26 @@ def _parse_chunk(lines: List[str], fmt: str, label_idx: int,
 
 
 def parse_file(path: str, has_header: bool = False, label_idx: int = 0,
-               num_features: Optional[int] = None
+               num_features: Optional[int] = None,
+               guard: Optional[IngestGuard] = None
                ) -> Tuple[np.ndarray, np.ndarray, Optional[List[str]]]:
     """Parse a data file.  Returns (label, features[N,F], header_names).
 
-    Uses the native multithreaded C++ loader (csrc/data_loader.cpp) when it
-    is available; the NumPy path below is the fallback and the behavioral
-    reference for tests."""
+    Uses the native multithreaded C++ loader (csrc/data_loader.cpp) when
+    it is available AND the file is clean; the native loader reports the
+    first malformed line it sees, and any dirt reroutes the file through
+    the guarded NumPy path below — the behavioral reference for tests —
+    so diagnostics and quarantine policy come from exactly one
+    implementation."""
     from .native import parse_file_native
+    g = guard if guard is not None else IngestGuard(path)
     native = parse_file_native(path, has_header=has_header,
                                label_idx=label_idx)
-    if native is not None:
-        label, feats, fmt = native
+    if native is not None and native[3] < 0:
+        label, feats, fmt, _ = native
         header: Optional[List[str]] = None
         if has_header:
-            with open(path, "r") as fh:
+            with open(path, "r", errors="replace") as fh:
                 first = fh.readline().rstrip("\r\n")
             delim = {"csv": ",", "tsv": "\t"}.get(fmt, "\t")
             header = first.split(delim)
@@ -159,22 +289,31 @@ def parse_file(path: str, has_header: bool = False, label_idx: int = 0,
             upto = min(num_features, feats.shape[1])
             fixed[:, :upto] = feats[:, :upto]
             feats = fixed
+        g.finish()
         return label, feats, header
+    if native is not None:
+        from ..utils import log
+        log.debug("native loader flagged a malformed line in %s — "
+                  "re-parsing with the guarded Python path", path)
 
-    with open(path, "r") as fh:
-        lines = fh.read().splitlines()
+    numbered = list(_numbered_lines(path, False))
     header: Optional[List[str]] = None
-    probe = [ln for ln in lines[:32] if ln.strip()]
+    probe = [ln for _, ln in numbered[:32]]
     fmt = detect_format(probe[1:] if has_header else probe)
-    if has_header and lines:
+    if has_header and numbered:
         delim = {"csv": ",", "tsv": "\t"}.get(fmt, "\t")
-        header = lines[0].split(delim)
+        header = numbered[0][1].rstrip("\r\n").split(delim)
         if label_idx >= 0 and fmt != "libsvm" and len(header) > label_idx:
             header = header[:label_idx] + header[label_idx + 1:]
-        lines = lines[1:]
+        numbered = numbered[1:]
+    lines = [ln for _, ln in numbered]
+    nums = [no for no, _ in numbered]
     if fmt == "libsvm":
-        label, feats = _parse_libsvm(lines, num_features)
+        label, feats = _parse_libsvm(lines, num_features, guard=g,
+                                     line_numbers=nums)
     else:
         delim = "," if fmt == "csv" else "\t"
-        label, feats = _parse_delimited(lines, delim, label_idx)
+        label, feats = _parse_delimited(lines, delim, label_idx,
+                                        guard=g, line_numbers=nums)
+    g.finish()
     return label, feats, header

@@ -8,7 +8,9 @@ SURVEY §5.  Two-round loading never holds more than one text chunk and
 the sample in memory:
 
   round 1a: stream the file once — count rows (and, for LibSVM, the max
-            feature index, which late rows may raise);
+            feature index, which late rows may raise; malformed LibSVM
+            lines are classified HERE so a garbage index can never
+            inflate the feature space);
   round 1b: stream again collecting ONLY the sampled lines (the sample
             indices are drawn exactly like the in-memory path:
             global row count + same seed -> the resulting mappers are
@@ -18,6 +20,15 @@ the sample in memory:
 
 Peak memory: bins [used_F, N] (1 byte/cell) + chunk + sample, instead of
 N * F * 8 bytes of floats.
+
+Malformed input is contained (docs/FAULT_TOLERANCE.md §Data boundary):
+every parse goes through the file's :class:`~.guard.IngestGuard`, which
+dedupes by physical line number — a bad line sampled in round 1b and
+met again in round 2 is quarantined, counted, and budgeted exactly
+once, and the preallocated bins/labels are cropped to the clean row
+count so they stay aligned.  File drift between rounds (a concurrent
+appender/truncator changing the size or row count after round 1) is a
+named ``LightGBMError``, not a silent mis-binning or a bare assert.
 
 Chunks are parsed with the Python parser; the one-round path prefers the
 native C++ loader whose fast atof can differ from float() by ~1 ulp, so
@@ -29,25 +40,41 @@ tests/test_streaming.py).
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
-
-import numpy as np
+import os
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 from ..utils import log
+from ..utils.log import LightGBMError
 from .binning import BinMapper
 from .dataset import BinnedDataset, Metadata, build_mappers_from_sample
-from .parser import _parse_chunk, detect_format  # noqa: F401 (re-export)
+from .guard import (IngestGuard, check_side_files_alignment, column_index,
+                    feature_value)
+from .parser import (_BadLine, _parse_chunk,  # noqa: F401 (re-export)
+                     detect_format)
+
+
+def _numbered_data_lines(path: str, skip_header: bool
+                         ) -> Iterator[Tuple[int, str]]:
+    """Yield (1-based physical line number, newline-stripped line) for
+    every non-blank data line, skipping the header.  Undecodable bytes
+    are replaced so they reach the classifier instead of raising
+    ``UnicodeDecodeError`` mid-stream."""
+    with open(path, "r", errors="replace") as fh:
+        lineno = 0
+        if skip_header:
+            fh.readline()
+            lineno = 1
+        for line in fh:
+            lineno += 1
+            line = line.rstrip("\r\n")
+            if line.strip():
+                yield lineno, line
 
 
 def _data_lines(path: str, skip_header: bool):
     """Yield raw data lines (newline-stripped), skipping the header."""
-    with open(path, "r") as fh:
-        if skip_header:
-            fh.readline()
-        for line in fh:
-            line = line.rstrip("\r\n")
-            if line.strip():
-                yield line
+    for _, line in _numbered_data_lines(path, skip_header):
+        yield line
 
 
 def _probe_format(path: str, has_header: bool) -> str:
@@ -62,7 +89,7 @@ def _probe_format(path: str, has_header: bool) -> str:
 def read_full_header_names(path: str) -> Tuple[List[str], str]:
     """(all header column names, detected format) from the first line."""
     fmt = _probe_format(path, True)
-    with open(path, "r") as fh:
+    with open(path, "r", errors="replace") as fh:
         first = fh.readline().rstrip("\r\n")
     delim = {"csv": ",", "tsv": "\t"}.get(fmt, "\t")
     return first.split(delim), fmt
@@ -76,6 +103,45 @@ def read_header_names(path: str, label_idx: int = 0) -> List[str]:
     return header
 
 
+def _scan_libsvm_max_col(line: str) -> int:
+    """Max column index of one LibSVM line, with the SAME token
+    validation as the real parse — raises :class:`_BadLine` on any
+    malformed token so a corrupt row can never inflate the feature
+    space (round 1a sizes the preallocated bin matrix from this)."""
+    parts = line.split()
+    start = 0
+    if parts and ":" not in parts[0]:
+        try:
+            feature_value(parts[0])
+        except ValueError:
+            raise _BadLine("unparseable_token",
+                           f"label token {parts[0]!r}")
+        start = 1
+    max_col = -1
+    for tok in parts[start:]:
+        col_s, sep, val_s = tok.partition(":")
+        if not sep:
+            raise _BadLine("unparseable_token",
+                           f"token {tok!r} is not index:value")
+        try:
+            col = column_index(col_s)
+        except ValueError:
+            raise _BadLine("bad_column_index",
+                           f"column index {col_s!r} in token {tok!r}")
+        try:
+            feature_value(val_s)
+        except ValueError:
+            raise _BadLine("unparseable_token",
+                           f"value {val_s!r} in token {tok!r}")
+        max_col = max(max_col, col)
+    return max_col
+
+
+def _drift_error(path: str, why: str) -> None:
+    raise LightGBMError(
+        f"Two-round loader: {path} changed between rounds ({why}) — a "
+        f"concurrent writer is mutating the file; re-run the load "
+        f"against a quiescent copy")
 
 
 def load_file_two_round(path: str, *, has_header: bool = False,
@@ -87,7 +153,9 @@ def load_file_two_round(path: str, *, has_header: bool = False,
                         weight_idx: int = -1, group_idx: int = -1,
                         data_random_seed: int = 1,
                         reference: Optional[BinnedDataset] = None,
-                        chunk_rows: int = 262144) -> BinnedDataset:
+                        chunk_rows: int = 262144,
+                        guard: Optional[IngestGuard] = None
+                        ) -> BinnedDataset:
     """Stream-load ``path`` into a BinnedDataset without materializing the
     float matrix.  Identical output to parse_file + from_matrix (asserted
     by tests/test_streaming.py); with ``reference`` the file is binned
@@ -95,20 +163,48 @@ def load_file_two_round(path: str, *, has_header: bool = False,
 
     ``weight_idx`` / ``group_idx`` name in-data columns (feature-space
     indices, dataset_loader.cpp SetHeader) whose values stream into
-    Metadata instead of features; callers put them in ignore_features."""
+    Metadata instead of features; callers put them in ignore_features.
+
+    ``guard`` carries the bad-row policy (default: fail fast on the
+    first malformed line, naming file:line + token)."""
+    import numpy as np
+
+    g = guard if guard is not None else IngestGuard(path)
     fmt = _probe_format(path, has_header)
+    try:
+        size_r1 = os.path.getsize(path)
+    except OSError:
+        size_r1 = -1
 
     # round 1a: row count (+ LibSVM feature count; skipped when the
-    # reference already fixes the feature space)
+    # reference already fixes the feature space).  LibSVM lines are
+    # fully token-validated here — a malformed line is classified NOW
+    # (fail fast / quarantine) instead of donating a garbage column
+    # index to the matrix allocation.
     num_data = 0
     max_col = -1
     scan_cols = fmt == "libsvm" and reference is None
-    for line in _data_lines(path, has_header):
+    delim = {"csv": ",", "tsv": "\t"}.get(fmt)
+    width_seeded = False
+    for lineno, line in _numbered_data_lines(path, has_header):
+        if not width_seeded and delim is not None:
+            # seed the ragged-row width from the file's FIRST data line
+            # with any fields (the native loader's schema rule) —
+            # round 1b parses a RANDOM sample, and seeding from
+            # whichever line is sampled first would let one ragged line
+            # invert classification for the whole file (and desync the
+            # continued-training shadow guard, which always re-reads
+            # from line 1)
+            parts = line.split(delim)
+            if any(p.strip() for p in parts):
+                g.expect_fields(len(parts))
+                width_seeded = True
         num_data += 1
         if scan_cols:
-            parts = line.split()
-            for tok in parts[1:] if ":" not in parts[0] else parts:
-                max_col = max(max_col, int(tok.split(":", 1)[0]))
+            try:
+                max_col = max(max_col, _scan_libsvm_max_col(line))
+            except _BadLine as bl:
+                g.bad_row(lineno, line, bl.reason, bl.detail)
     if num_data == 0:
         log.fatal("Two-round loader: %s contains no data rows", path)
 
@@ -127,11 +223,26 @@ def load_file_two_round(path: str, *, has_header: bool = False,
             sample_idx = np.arange(num_data)
         wanted = np.zeros(num_data, bool)
         wanted[sample_idx] = True
-        sample_lines = [ln for i, ln in
-                        enumerate(_data_lines(path, has_header))
-                        if wanted[i]]
+        sample_lines: List[str] = []
+        sample_nums: List[int] = []
+        for i, (lineno, ln) in enumerate(
+                _numbered_data_lines(path, has_header)):
+            if i >= num_data:
+                break       # late concurrent append: round 2 names it
+            if wanted[i]:
+                sample_lines.append(ln)
+                sample_nums.append(lineno)
         num_features = (max_col + 1) if fmt == "libsvm" else None
-        _, sample = _parse_chunk(sample_lines, fmt, label_idx, num_features)
+        seen0, bad0 = g.rows_seen, g.bad_total
+        _, sample = _parse_chunk(sample_lines, fmt, label_idx,
+                                 num_features, guard=g,
+                                 line_numbers=sample_nums)
+        # the sampled GOOD lines will be parsed again in round 2: keep
+        # their bad-row classifications (deduped by line number) but
+        # give back their budget-denominator contribution, or a big
+        # sample would make max_bad_row_fraction silently looser
+        sample_good = (g.rows_seen - seen0) - (g.bad_total - bad0)
+        g.rewind_good_rows(sample_good)
         F = sample.shape[1]
 
     ds = BinnedDataset()
@@ -151,8 +262,11 @@ def load_file_two_round(path: str, *, has_header: bool = False,
         ds.real_to_inner = reference.real_to_inner.copy()
         ds.mappers = reference.mappers
     else:
+        # trivial-feature filtering scales to the (estimated) CLEAN row
+        # count: bad rows already classified never reach the bins, so
+        # they must not count toward the filter denominator either
         per_real = build_mappers_from_sample(
-            sample, num_data, max_bin=max_bin,
+            sample, max(num_data - g.bad_total, 1), max_bin=max_bin,
             min_data_in_bin=min_data_in_bin,
             min_data_in_leaf=min_data_in_leaf,
             categorical_features=set(int(c) for c in categorical_features),
@@ -186,16 +300,28 @@ def load_file_two_round(path: str, *, has_header: bool = False,
     weights = np.zeros(num_data, np.float64) if weight_idx >= 0 else None
     qids = np.zeros(num_data, np.float64) if group_idx >= 0 else None
 
+    # drift gate: the file must not have changed since round 1 (size
+    # now, exact row count re-verified during the round-2 stream)
+    try:
+        size_r2 = os.path.getsize(path)
+    except OSError:
+        size_r2 = -2
+    if size_r2 != size_r1:
+        _drift_error(path, f"size {size_r1} -> {size_r2} bytes")
+
     # round 2: chunked parse + bin
     off = 0
+    lines_seen = 0
     buf: List[str] = []
+    nums: List[int] = []
     nf = ds.num_total_features if fmt == "libsvm" else None
 
     def flush():
-        nonlocal off, buf
+        nonlocal off, buf, nums
         if not buf:
             return
-        lab, feats = _parse_chunk(buf, fmt, label_idx, nf)
+        lab, feats = _parse_chunk(buf, fmt, label_idx, nf, guard=g,
+                                  line_numbers=nums)
         n = feats.shape[0]
         for inner, f in enumerate(ds.used_feature_map):
             col = feats[:, f] if f < feats.shape[1] else \
@@ -209,15 +335,43 @@ def load_file_two_round(path: str, *, has_header: bool = False,
             qids[off:off + n] = feats[:, group_idx]
         off += n
         buf = []
+        nums = []
 
-    for line in _data_lines(path, has_header):
+    for lineno, line in _numbered_data_lines(path, has_header):
+        lines_seen += 1
+        if lines_seen > num_data:
+            break               # named below — not an assert, not a hang
         buf.append(line)
+        nums.append(lineno)
         if len(buf) >= chunk_rows:
             flush()
     flush()
-    assert off == num_data, (off, num_data)
 
-    ds.metadata = Metadata(num_data)
+    if lines_seen != num_data:
+        _drift_error(path, f"{num_data} data rows counted in round 1, "
+                           f"{'>' if lines_seen > num_data else ''}"
+                           f"{lines_seen} seen in round 2")
+    if off + g.bad_total != num_data:
+        _drift_error(path, f"{num_data} rows counted, {off} binned + "
+                           f"{g.bad_total} quarantined")
+    if off == 0:
+        raise LightGBMError(
+            f"Two-round loader: every row of {path} was quarantined "
+            f"({g.bad_total} bad rows, see {g.quarantine_path}) — "
+            f"no clean data to train on")
+
+    if off < num_data:
+        # quarantined rows: crop the preallocated arrays to the clean
+        # count so bins/labels/metadata stay aligned
+        ds.bins = np.ascontiguousarray(ds.bins[:, :off])
+        labels = labels[:off]
+        if weights is not None:
+            weights = weights[:off]
+        if qids is not None:
+            qids = qids[:off]
+
+    check_side_files_alignment(path, g.bad_total)
+    ds.metadata = Metadata(off)
     ds.metadata.set_label(labels)
     ds.metadata.load_side_files(path)
     if weights is not None:
@@ -225,4 +379,5 @@ def load_file_two_round(path: str, *, has_header: bool = False,
     if qids is not None:
         from .column_roles import qid_to_query_sizes
         ds.metadata.set_query(qid_to_query_sizes(qids))
+    g.finish()
     return ds

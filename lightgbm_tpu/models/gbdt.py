@@ -30,6 +30,7 @@ from ..ops.grow import (GrowParams, SerialComm, grow_tree, pack_tree_arrays,
 from ..ops.ordered_grow import grow_tree_ordered, pack_u8_words
 from ..ops.predict import predict_binned_forest, predict_binned_tree
 from ..utils import compile_cache, log, timetag
+from ..utils.log import LightGBMError
 from .tree import Tree
 
 
@@ -1763,7 +1764,17 @@ class GBDT:
         return pairs
 
     def load_model_from_string(self, text: str) -> None:
-        """gbdt.cpp:679-760."""
+        """gbdt.cpp:679-760.
+
+        Truncation/corruption containment (docs/FAULT_TOLERANCE.md
+        §Data boundary): every header field, tree section, and the
+        footer is validated, and any damage raises ``LightGBMError``
+        naming the section, the tree index, and the file line — a
+        half-written model file is a clean client error through the
+        serve ``/reload`` 400 path and the CLI ``input_model``, never
+        an index crash mid-predict."""
+        import re
+
         lines = text.splitlines()
         kv: Dict[str, str] = {}
         for ln in lines:
@@ -1774,22 +1785,65 @@ class GBDT:
                 kv[k.strip()] = v.strip()
         if "num_class" not in kv:
             log.fatal("Model file doesn't specify the number of classes")
-        self.num_class = int(kv["num_class"])
-        self.label_idx = int(kv.get("label_index", 0))
-        self.max_feature_idx = int(kv.get("max_feature_idx", 0))
-        self.sigmoid = float(kv.get("sigmoid", -1.0))
+
+        def _header_int(key, default):
+            raw = kv.get(key, default)
+            try:
+                return int(raw)
+            except ValueError:
+                log.fatal("Model file header: %s=%r is not an integer "
+                          "— corrupt model file?", key, raw)
+
+        def _header_float(key, default):
+            raw = kv.get(key, default)
+            try:
+                return float(raw)
+            except ValueError:
+                log.fatal("Model file header: %s=%r is not a number "
+                          "— corrupt model file?", key, raw)
+
+        self.num_class = _header_int("num_class", "1")
+        if self.num_class < 1:
+            log.fatal("Model file header: num_class=%d must be >= 1",
+                      self.num_class)
+        self.label_idx = _header_int("label_index", 0)
+        self.max_feature_idx = _header_int("max_feature_idx", 0)
+        self.sigmoid = _header_float("sigmoid", -1.0)
         self.feature_names = kv.get("feature_names", "").split()
         self.feature_infos_ = kv.get("feature_infos", "").split()
         self.objective_name = kv.get("objective", "")
-        # parse tree blocks
+        # parse tree blocks; the footer ("feature importances:",
+        # written by every save — reference gbdt.cpp too) doubles as
+        # the truncation sentinel: a file chopped anywhere before it
+        # is detectably incomplete even when the chop lands exactly on
+        # a tree boundary
+        footer_pos = text.find("\nfeature importances")
+        if footer_pos < 0:
+            log.fatal("Model file ends without the 'feature importances' "
+                      "footer — truncated mid-write? (re-save the model "
+                      "or restore from a good copy)")
+        tree_marks = list(re.finditer(r"(?m)^Tree=(.*)$", text))
+        tree_marks = [m for m in tree_marks if m.start() < footer_pos]
         self.models = []
-        blocks = text.split("Tree=")
-        for blk in blocks[1:]:
-            body = blk.split("\n", 1)[1]
-            stop_at = body.find("\nfeature importances")
-            if stop_at >= 0:
-                body = body[:stop_at]
-            self.models.append(Tree.from_string(body))
+        for i, m in enumerate(tree_marks):
+            idx_s = m.group(1).strip()
+            line_no = text.count("\n", 0, m.start()) + 1
+            if idx_s != str(i):
+                log.fatal("Model file: expected Tree=%d, found Tree=%s "
+                          "(line %d) — trees missing or reordered; "
+                          "corrupt model file?", i, idx_s, line_no)
+            start = m.end()
+            end = tree_marks[i + 1].start() if i + 1 < len(tree_marks) \
+                else footer_pos
+            try:
+                self.models.append(Tree.from_string(text[start:end]))
+            except LightGBMError as exc:
+                log.fatal("Model file: Tree=%s (line %d): %s",
+                          idx_s, line_no, exc)
+        if self.models and len(self.models) % self.num_class != 0:
+            log.fatal("Model file: %d tree(s) is not a multiple of "
+                      "num_class=%d — trees missing; truncated model "
+                      "file?", len(self.models), self.num_class)
         self.num_init_iteration = len(self.models) // max(self.num_class, 1)
         self.iter_ = self.num_init_iteration
         if not hasattr(self, "objective") or self.objective is None:

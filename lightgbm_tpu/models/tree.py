@@ -215,7 +215,16 @@ class Tree:
 
     @classmethod
     def from_string(cls, text: str) -> "Tree":
-        """Tree(str) parser (tree.cpp:368-430)."""
+        """Tree(str) parser (tree.cpp:368-430).
+
+        Corruption is contained, never propagated: a missing section, a
+        short array (the signature of a file truncated mid-row), an
+        unparseable number, or structurally impossible child/feature
+        indices all raise :class:`LightGBMError` naming the offending
+        section — a half-written model file must be a clean, named
+        client error (serve ``/reload`` -> 400, CLI ``input_model`` ->
+        fatal), not an index crash at predict time."""
+        from ..utils.log import LightGBMError
         kv: Dict[str, str] = {}
         for line in text.splitlines():
             if "=" in line:
@@ -229,21 +238,51 @@ class Tree:
                     "shrinkage", "decision_type")
         missing = [k for k in required if k not in kv]
         if missing and kv.get("num_leaves") != "1":
-            raise ValueError(f"Tree model string format error: missing {missing}")
-        num_leaves = int(kv["num_leaves"])
+            raise LightGBMError(
+                f"Tree model string format error: missing section(s) "
+                f"{missing} — truncated or corrupt model file?")
+        try:
+            num_leaves = int(kv["num_leaves"])
+        except ValueError:
+            raise LightGBMError(
+                f"Tree model string format error: num_leaves="
+                f"{kv['num_leaves']!r} is not an integer")
+        if num_leaves < 1:
+            raise LightGBMError(
+                f"Tree model string format error: num_leaves="
+                f"{num_leaves} must be >= 1")
+        if num_leaves > (1 << 20):
+            raise LightGBMError(
+                f"Tree model string format error: num_leaves="
+                f"{num_leaves} is absurd (corrupt header digit?) — "
+                f"refusing the allocation")
         t = cls(num_leaves)
 
-        def ints(key, count):
+        def _values(key, count, conv, dtype):
             if count <= 0 or key not in kv:
-                return np.zeros(max(count, 0), dtype=np.int32)
-            return np.asarray([int(float(x)) for x in kv[key].split()][:count],
-                              dtype=np.int32)
+                return np.zeros(max(count, 0), dtype=dtype)
+            toks = kv[key].split()
+            if len(toks) < count:
+                raise LightGBMError(
+                    f"Tree model string format error: section {key} has "
+                    f"{len(toks)} value(s), expected {count} — file "
+                    f"truncated mid-row?")
+            try:
+                vals = [conv(x) for x in toks[:count]]
+                return np.asarray(vals, dtype=dtype)
+            except (ValueError, OverflowError) as exc:
+                # OverflowError: int(float("1e999")) or an int past the
+                # int32 range — a corrupt digit making a section
+                # unrepresentable
+                raise LightGBMError(
+                    f"Tree model string format error: section {key}: "
+                    f"{exc}")
+
+        def ints(key, count):
+            return _values(key, count, lambda x: int(float(x)), np.int32)
 
         def floats(key, count):
-            if count <= 0 or key not in kv:
-                return np.zeros(max(count, 0), dtype=np.float64)
-            return np.asarray([float(x) for x in kv[key].split()][:count],
-                              dtype=np.float64)
+            return _values(key, count, float, np.float64)
 
         n = num_leaves - 1
         t.split_feature = ints("split_feature", n)
@@ -258,7 +297,28 @@ class Tree:
         t.leaf_count = ints("leaf_count", num_leaves)
         t.internal_value = floats("internal_value", n)
         t.internal_count = ints("internal_count", n)
-        t.shrinkage = float(kv["shrinkage"])
+        try:
+            t.shrinkage = float(kv["shrinkage"])
+        except ValueError:
+            raise LightGBMError(
+                f"Tree model string format error: shrinkage="
+                f"{kv['shrinkage']!r} is not a number")
+        # structural sanity: child links must stay inside the node/leaf
+        # ranges (an internal node i in [0, n), a leaf ~l with l in
+        # [0, num_leaves)) and split features must be non-negative —
+        # out-of-range values walk predict() straight into garbage
+        for key, arr in (("left_child", t.left_child),
+                         ("right_child", t.right_child)):
+            if arr.size and (
+                    (arr >= n).any() or (arr < -num_leaves).any()):
+                raise LightGBMError(
+                    f"Tree model string format error: section {key} "
+                    f"holds an out-of-range node index (num_leaves="
+                    f"{num_leaves}) — corrupt model file?")
+        if t.split_feature.size and (t.split_feature < 0).any():
+            raise LightGBMError(
+                "Tree model string format error: negative "
+                "split_feature index — corrupt model file?")
         return t
 
     def to_json(self) -> dict:

@@ -82,12 +82,43 @@ from .health import NoHealthyReplicas
 _request_ids = itertools.count(1)
 
 
+def _rows_to_matrix(rows) -> np.ndarray:
+    """Validate a JSON ``rows`` payload into an [n, F] f32 matrix.  Any
+    defect — a row that is not a list, a ragged width, a non-numeric
+    element — raises ``ValueError`` naming the OFFENDING ROW INDEX, so
+    the client's 400 pinpoints the bad row instead of echoing a numpy
+    shape error (or worse, building an object array)."""
+    if not isinstance(rows, (list, tuple)):
+        raise ValueError("rows must be a list")
+    if rows and not isinstance(rows[0], (list, tuple)):
+        rows = [rows]                  # one flat row
+    width = None
+    for i, row in enumerate(rows):
+        if not isinstance(row, (list, tuple)):
+            raise ValueError(
+                f"row {i}: expected a list of feature values, got "
+                f"{type(row).__name__}")
+        if width is None:
+            width = len(row)
+        elif len(row) != width:
+            raise ValueError(
+                f"row {i}: {len(row)} feature(s) where row 0 has "
+                f"{width}")
+        for j, v in enumerate(row):
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError(
+                    f"row {i}: non-numeric value {v!r} at feature {j}")
+    return np.asarray(rows, dtype=np.float32).reshape(len(rows),
+                                                      width or 0)
+
+
 def _parse_rows(body: bytes, content_type: str):
     """Request body -> ``([n, F] f32 row matrix, options dict)`` (JSON
     list-of-lists / one flat list for a single row, or CSV/TSV text
     lines).  Options (JSON envelope only): ``raw_score`` and
     ``deadline_ms`` — a per-request latency budget; work the budget
-    cannot cover is shed with 504 before consuming device time."""
+    cannot cover is shed with 504 before consuming device time.  Every
+    validation error names the offending row index."""
     opts = {"raw_score": False, "deadline_ms": None}
     if "json" in (content_type or ""):
         payload = json.loads(body.decode("utf-8"))
@@ -98,16 +129,38 @@ def _parse_rows(body: bytes, content_type: str):
                 opts["deadline_ms"] = float(payload["deadline_ms"])
         else:
             rows = payload
-        arr = np.asarray(rows, dtype=np.float32)
+        arr = _rows_to_matrix(rows)
     else:
-        lines = [ln for ln in body.decode("utf-8").splitlines()
-                 if ln.strip()]
+        lines = [ln for ln in body.decode("utf-8", errors="replace")
+                 .splitlines() if ln.strip()]
         delim = "\t" if lines and "\t" in lines[0] else ","
-        arr = np.asarray([[float(v) for v in ln.split(delim)]
-                          for ln in lines], dtype=np.float32)
+        parsed = []
+        width = None
+        for i, ln in enumerate(lines):
+            parts = ln.split(delim)
+            if width is None:
+                width = len(parts)
+            elif len(parts) != width:
+                raise ValueError(
+                    f"row {i}: {len(parts)} feature(s) where row 0 "
+                    f"has {width}")
+            try:
+                parsed.append([float(v) for v in parts])
+            except ValueError:
+                raise ValueError(f"row {i}: unparseable feature value "
+                                 f"in {ln[:80]!r}")
+        arr = np.asarray(parsed, dtype=np.float32)
     if arr.ndim == 1:
         arr = arr.reshape(1, -1)
     return arr, opts
+
+
+def _first_nonfinite_row(arr: np.ndarray) -> int:
+    """Index of the first row holding a NaN/Inf feature, or -1."""
+    bad = ~np.isfinite(arr)
+    if not bad.any():
+        return -1
+    return int(np.argmax(bad.any(axis=1)))
 
 
 def _json_predictions(raw: np.ndarray, out: np.ndarray,
@@ -224,8 +277,36 @@ class _Handler(BaseHTTPRequestHandler):
         # tests/test_fleet.py).
         with obs.trace_span("Serve::request",
                             args={"request_id": req_id}) as rh:
+            # ingress hardening (docs/FAULT_TOLERANCE.md §Data
+            # boundary): size cap, per-row validation, and the
+            # non-finite policy ALL shed before any device time — a
+            # 4xx here never opens a Predict::forest span (trace-pinned
+            # by tests/test_ingest_chaos.py)
             try:
-                length = int(self.headers.get("Content-Length", 0))
+                length = int(self.headers.get("Content-Length", 0) or 0)
+            except (TypeError, ValueError):
+                obs.inc("serve_bad_requests")
+                if rh is not None:
+                    rh.args["status"] = 400
+                self.close_connection = True
+                self._reply(400, {"error": "bad request: malformed "
+                                           "Content-Length header"},
+                            req_id)
+                return
+            if srv.max_body_bytes and length > srv.max_body_bytes:
+                obs.inc("serve_bad_requests")
+                obs.inc("serve_oversize_requests")
+                if rh is not None:
+                    rh.args["status"] = 413
+                # the unread body makes the connection unusable for
+                # keep-alive; tell the client and close it
+                self.close_connection = True
+                self._reply(413, {
+                    "error": f"request body {length} bytes exceeds "
+                             f"serve_max_body_bytes="
+                             f"{srv.max_body_bytes}"}, req_id)
+                return
+            try:
                 body = self.rfile.read(length)
                 rows, opts = _parse_rows(
                     body, self.headers.get("Content-Type", ""))
@@ -238,6 +319,14 @@ class _Handler(BaseHTTPRequestHandler):
                     raise ValueError(
                         f"expected {srv.fleet.num_features} features per "
                         f"row, got {rows.shape[1]}")
+                if srv.nonfinite_policy == "reject":
+                    bad_row = _first_nonfinite_row(rows)
+                    if bad_row >= 0:
+                        raise ValueError(
+                            f"row {bad_row}: non-finite feature value "
+                            f"(serve_nonfinite_policy=reject; set "
+                            f"serve_nonfinite_policy=propagate to let "
+                            f"NaN/Inf through)")
             except Exception as exc:
                 obs.inc("serve_bad_requests")
                 if rh is not None:
@@ -317,7 +406,28 @@ class _Handler(BaseHTTPRequestHandler):
                             args={"request_id": req_id,
                                   "path": "/reload"}) as rh:
             try:
-                length = int(self.headers.get("Content-Length", 0))
+                length = int(self.headers.get("Content-Length", 0) or 0)
+            except (TypeError, ValueError):
+                obs.inc("serve_bad_requests")
+                if rh is not None:
+                    rh.args["status"] = 400
+                self.close_connection = True
+                self._reply(400, {"error": "bad request: malformed "
+                                           "Content-Length header"},
+                            req_id)
+                return
+            if srv.max_body_bytes and length > srv.max_body_bytes:
+                obs.inc("serve_bad_requests")
+                obs.inc("serve_oversize_requests")
+                if rh is not None:
+                    rh.args["status"] = 413
+                self.close_connection = True
+                self._reply(413, {
+                    "error": f"request body {length} bytes exceeds "
+                             f"serve_max_body_bytes="
+                             f"{srv.max_body_bytes}"}, req_id)
+                return
+            try:
                 payload = json.loads(self.rfile.read(length) or b"{}")
                 model = (payload or {}).get("model", "")
                 target = (payload or {}).get("target", "primary")
@@ -367,7 +477,17 @@ class PredictServer:
                  request_timeout: float = 60.0,
                  params: Optional[dict] = None,
                  state_file: Optional[str] = None,
-                 warm_in_background: bool = False):
+                 warm_in_background: bool = False,
+                 max_body_bytes: int = 33554432,
+                 nonfinite_policy: str = "reject"):
+        # ingress hardening: request body cap (-> 413) and the NaN/Inf
+        # feature policy (reject -> 400 naming the row, or propagate)
+        self.max_body_bytes = max(int(max_body_bytes), 0)
+        if nonfinite_policy not in ("reject", "propagate"):
+            raise ValueError(
+                f"Unknown serve_nonfinite_policy {nonfinite_policy!r} "
+                f"(expected reject or propagate)")
+        self.nonfinite_policy = str(nonfinite_policy)
         if isinstance(forest, Fleet):
             self.fleet = forest
         else:
@@ -590,7 +710,11 @@ def serve_from_config(config, params=None) -> PredictServer:
         max_delay_ms=float(config.serve_max_delay_ms),
         params=dict(params or {}),
         state_file=state_file,
-        warm_in_background=True)
+        warm_in_background=True,
+        max_body_bytes=int(getattr(config, "serve_max_body_bytes",
+                                   33554432)),
+        nonfinite_policy=str(getattr(config, "serve_nonfinite_policy",
+                                     "reject")))
     # the boot model is the first last-good model: a crash before any
     # reload restores to exactly what was serving
     server.manager.note_good(model_path, generation=fleet.generation)

@@ -33,6 +33,16 @@ fragments:
   fault the traffic does (and recovery probes succeed only once the
   fault is lifted).
 
+- data-corpus injectors (PR 13, the ingest chaos suite in
+  tests/test_ingest_chaos.py + tests/test_fuzz_ingest.py):
+  ``mangle_rows`` (unparseable tokens in a numeric column),
+  ``ragged_rows`` (field-count drift), ``truncate_mid_row`` (torn
+  write), ``concurrent_append`` (a producer still writing the file
+  between the two-round loader's rounds), ``corrupt_model_file``
+  (truncated / footer-less / bit-rotted model artifacts) — each
+  deterministic and returning the ground-truth line numbers the
+  quarantine accounting is checked against.
+
 None of these are test-only hacks around private invariants: they throw
 real exceptions through real call stacks, which is the point.
 """
@@ -399,6 +409,174 @@ def fail_warmup(times: int = 1) -> Iterator[dict]:
         yield stats
     finally:
         CompiledForest.warmup = orig
+
+
+# ---------------------------------------------------------------------------
+# data-corpus injectors (io/ data-boundary hardening,
+# docs/FAULT_TOLERANCE.md §Data boundary).  Deterministic (seeded) file
+# mutators producing exactly the dirt the IngestGuard classifies —
+# tests/test_ingest_chaos.py trains through them and pins quarantine
+# accounting, and tests/test_fuzz_ingest.py sprays random variants.
+
+
+def _detect_delim(line: str) -> str:
+    if "\t" in line:
+        return "\t"
+    if "," in line:
+        return ","
+    return " "
+
+
+def mangle_rows(path: str, fraction: float = 0.05, seed: int = 0,
+                token: str = "##garbage##", skip_header: bool = False
+                ) -> list:
+    """Replace one feature field of ~``fraction`` of the data rows with
+    an unparseable token (the classic exporter bug: a stray string in a
+    numeric column).  Returns the SORTED 1-based file line numbers
+    mangled — the ground truth the quarantine sink is checked against."""
+    import numpy as np
+
+    with open(path, "r") as fh:
+        lines = fh.read().splitlines()
+    first = 1 if skip_header else 0
+    data_idx = [i for i in range(first, len(lines)) if lines[i].strip()]
+    k = max(1, int(round(fraction * len(data_idx))))
+    rng = np.random.RandomState(seed)
+    chosen = sorted(rng.choice(len(data_idx), size=min(k, len(data_idx)),
+                               replace=False))
+    mangled = []
+    for c in chosen:
+        i = data_idx[int(c)]
+        delim = _detect_delim(lines[i])
+        parts = lines[i].split(delim)
+        parts[min(1, len(parts) - 1)] = token  # a feature, not the label
+        lines[i] = delim.join(parts)
+        mangled.append(i + 1)
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return mangled
+
+
+def ragged_rows(path: str, fraction: float = 0.05, seed: int = 0,
+                mode: str = "drop", skip_header: bool = False) -> list:
+    """Drop (``mode="drop"``) or duplicate (``mode="add"``) the last
+    field of ~``fraction`` of the data rows — the torn-write /
+    schema-drift shape of dirt.  Returns sorted 1-based line numbers."""
+    import numpy as np
+
+    if mode not in ("drop", "add"):
+        raise ValueError(f"ragged_rows: unknown mode {mode!r}")
+    with open(path, "r") as fh:
+        lines = fh.read().splitlines()
+    first = 1 if skip_header else 0
+    data_idx = [i for i in range(first, len(lines)) if lines[i].strip()]
+    k = max(1, int(round(fraction * len(data_idx))))
+    rng = np.random.RandomState(seed)
+    chosen = sorted(rng.choice(len(data_idx), size=min(k, len(data_idx)),
+                               replace=False))
+    out = []
+    for c in chosen:
+        i = data_idx[int(c)]
+        delim = _detect_delim(lines[i])
+        parts = lines[i].split(delim)
+        if mode == "drop" and len(parts) > 1:
+            parts = parts[:-1]
+        else:
+            parts = parts + [parts[-1]]
+        lines[i] = delim.join(parts)
+        out.append(i + 1)
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return out
+
+
+def truncate_mid_row(path: str) -> int:
+    """Chop the file in the MIDDLE of its last data row (a torn
+    write/partial download): the resulting final line is ragged or
+    holds a half-number.  Returns the 1-based line number truncated."""
+    with open(path, "rb") as fh:
+        blob = fh.read()
+    body = blob.rstrip(b"\n")
+    last_nl = body.rfind(b"\n")
+    last_line = body[last_nl + 1:]
+    keep = last_nl + 1 + max(1, len(last_line) // 2)
+    with open(path, "wb") as fh:
+        fh.write(blob[:keep])
+    return body[:last_nl + 1].count(b"\n") + 1
+
+
+@contextlib.contextmanager
+def concurrent_append(path: str, extra_text: str,
+                      after_reads: int = 2) -> Iterator[dict]:
+    """Append ``extra_text`` to ``path`` after its ``after_reads``-th
+    full read pass — for the two-round loader (round 1a count, round 1b
+    sample, round 2 fill) the default lands the append exactly at the
+    round-1/round-2 boundary, simulating a concurrent producer still
+    writing the file.  The loader must refuse with a named drift
+    ``LightGBMError``, never mis-bin.  Yields a stats dict
+    (``completed`` read passes, ``appended`` flag)."""
+    from ..io import streaming
+
+    orig = streaming._numbered_data_lines
+    state = {"completed": 0, "appended": False}
+
+    def racing_lines(p, skip_header):
+        if os.path.abspath(str(p)) == os.path.abspath(path):
+            if state["completed"] >= int(after_reads) \
+                    and not state["appended"]:
+                state["appended"] = True
+                with open(path, "a") as fh:
+                    fh.write(extra_text)
+            yield from orig(p, skip_header)
+            state["completed"] += 1
+        else:
+            yield from orig(p, skip_header)
+
+    streaming._numbered_data_lines = racing_lines
+    try:
+        yield state
+    finally:
+        streaming._numbered_data_lines = orig
+
+
+def corrupt_model_file(path: str, mode: str = "truncate_tree") -> str:
+    """Damage a saved model file the way real storage does:
+
+    - ``"truncate_tree"``: chop the text mid-way through the last tree
+      block (half-written upload);
+    - ``"chop_footer"``: cut everything from the last ``Tree=`` on —
+      whole trees missing AND no ``feature importances`` footer;
+    - ``"garbage_field"``: replace the first ``leaf_value`` number with
+      a non-numeric token (bit rot under a valid length).
+
+    Returns a short description of what was done.  The loader contract
+    under test: ``LightGBMError`` naming the damage — serve ``/reload``
+    turns it into a clean 400, never a half-loaded forest."""
+    with open(path, "r") as fh:
+        text = fh.read()
+    if mode == "truncate_tree":
+        last = text.rfind("Tree=")
+        if last < 0:
+            raise ValueError(f"{path} has no Tree= blocks")
+        cut = last + (len(text) - last) // 2
+        out = text[:cut]
+        what = f"truncated mid-tree at byte {cut}"
+    elif mode == "chop_footer":
+        last = text.rfind("Tree=")
+        if last < 0:
+            raise ValueError(f"{path} has no Tree= blocks")
+        out = text[:last]
+        what = f"chopped from last Tree= (byte {last})"
+    elif mode == "garbage_field":
+        if "leaf_value=" not in text:
+            raise ValueError(f"{path} has no leaf_value section")
+        out = text.replace("leaf_value=", "leaf_value=@@rot@@ ", 1)
+        what = "first leaf_value poisoned with a non-numeric token"
+    else:
+        raise ValueError(f"corrupt_model_file: unknown mode {mode!r}")
+    with open(path, "w") as fh:
+        fh.write(out)
+    return what
 
 
 def flip_byte(path: str, offset: int = -1) -> None:

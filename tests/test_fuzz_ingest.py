@@ -1,0 +1,150 @@
+"""Ingest fuzzer (marker ``fuzz``, tier-1-fast subset): ~200 seeded
+random byte/line mutations of valid CSV / TSV / LibSVM files pushed
+through ``parse_file``, ``load_file_two_round``, and
+``Tree.from_string``.
+
+THE contract under test: every outcome is either a successful parse or
+a ``LightGBMError`` — any other exception type (bare ValueError,
+IndexError, UnicodeDecodeError, OverflowError, MemoryError from a
+corrupt-digit allocation...) fails the test.  That is the whole data
+boundary in one sentence: dirt is a NAMED, CLASSIFIED event, never an
+unclassified crash.
+"""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.io.guard import IngestGuard
+from lightgbm_tpu.io.parser import parse_file
+from lightgbm_tpu.io.streaming import load_file_two_round
+from lightgbm_tpu.models.tree import Tree
+from lightgbm_tpu.utils.log import LightGBMError
+
+pytestmark = pytest.mark.fuzz
+
+#: bytes the mutator splices in: format chars, signs, digits, NA-ish
+#: letters, raw garbage — the alphabet real corruption is made of
+_SPLICE = (b",\t:; -+.eE0123456789naNAxz#\x00\xff\n"
+           b"infNULL@")
+
+
+def _csv_seed():
+    rng = np.random.RandomState(11)
+    rows = ["lab,a,b,c"]
+    for i in range(20):
+        rows.append(",".join([f"{i % 2}"]
+                             + [f"{v:.4f}" for v in rng.normal(size=3)]))
+    return ("\n".join(rows) + "\n").encode(), {"has_header": True}
+
+
+def _tsv_seed():
+    rng = np.random.RandomState(12)
+    rows = []
+    for i in range(20):
+        rows.append("\t".join([f"{i % 2}"]
+                              + [f"{v:.4f}" for v in rng.normal(size=4)]))
+    return ("\n".join(rows) + "\n").encode(), {}
+
+
+def _libsvm_seed():
+    rng = np.random.RandomState(13)
+    rows = []
+    for i in range(20):
+        pairs = [f"{c}:{rng.normal():.4f}"
+                 for c in sorted(rng.choice(8, size=3, replace=False))]
+        rows.append(" ".join([f"{i % 2}"] + pairs))
+    return ("\n".join(rows) + "\n").encode(), {}
+
+
+def _mutate(blob: bytes, rng: np.random.RandomState) -> bytes:
+    """One random structural or byte-level mutation."""
+    b = bytearray(blob)
+    op = rng.randint(6)
+    if op == 0 and b:                      # flip random bytes
+        for _ in range(rng.randint(1, 8)):
+            b[rng.randint(len(b))] ^= 1 << rng.randint(8)
+    elif op == 1 and b:                    # splice random bytes in
+        pos = rng.randint(len(b))
+        ins = bytes(_SPLICE[rng.randint(len(_SPLICE))]
+                    for _ in range(rng.randint(1, 12)))
+        b[pos:pos] = ins
+    elif op == 2 and b:                    # delete a span
+        lo = rng.randint(len(b))
+        hi = min(len(b), lo + rng.randint(1, 32))
+        del b[lo:hi]
+    elif op == 3:                          # truncate
+        b = b[:rng.randint(len(b) + 1)]
+    elif op == 4:                          # duplicate + shuffle lines
+        lines = bytes(b).split(b"\n")
+        lines.append(lines[rng.randint(len(lines))])
+        rng.shuffle(lines)
+        b = bytearray(b"\n".join(lines))
+    else:                                  # overwrite a span w/ splice
+        if b:
+            lo = rng.randint(len(b))
+            hi = min(len(b), lo + rng.randint(1, 16))
+            for i in range(lo, hi):
+                b[i] = _SPLICE[rng.randint(len(_SPLICE))]
+    return bytes(b)
+
+
+def _check_outcome(fn, what, i):
+    try:
+        fn()
+    except LightGBMError:
+        pass                               # the NAMED outcome: allowed
+    except Exception as exc:               # noqa: BLE001 - the contract
+        pytest.fail(f"mutation {i} ({what}): {type(exc).__name__} "
+                    f"escaped the data boundary: {exc!r}")
+
+
+@pytest.mark.parametrize("seed_fn", [_csv_seed, _tsv_seed, _libsvm_seed],
+                         ids=["csv", "tsv", "libsvm"])
+def test_parsers_never_escape_lightgbmerror(tmp_path, seed_fn):
+    blob, kw = seed_fn()
+    rng = np.random.RandomState(hash(seed_fn.__name__) % (2 ** 31))
+    p = tmp_path / "fuzz.dat"
+    for i in range(55):
+        p.write_bytes(_mutate(blob, rng))
+        _check_outcome(
+            lambda: parse_file(str(p), **kw), "parse_file", i)
+        _check_outcome(
+            lambda: parse_file(
+                str(p), guard=IngestGuard(str(p), policy="quarantine",
+                                          max_bad_row_fraction=0.5),
+                **kw),
+            "parse_file/quarantine", i)
+        _check_outcome(
+            lambda: load_file_two_round(
+                str(p), max_bin=15, min_data_in_leaf=5,
+                has_header=bool(kw.get("has_header"))),
+            "load_file_two_round", i)
+
+
+def test_tree_from_string_never_escapes_lightgbmerror():
+    # a real tree text as the seed: structurally valid, then mutated
+    seed = (
+        "num_leaves=3\n"
+        "split_feature=1 0\n"
+        "split_gain=1.5 0.75\n"
+        "threshold=0.25 -1.5\n"
+        "decision_type=0 0\n"
+        "left_child=1 -1\n"
+        "right_child=-2 -3\n"
+        "leaf_parent=1 0 1\n"
+        "leaf_value=0.1 -0.2 0.3\n"
+        "leaf_count=10 20 30\n"
+        "internal_value=0.05 0.15\n"
+        "internal_count=60 30\n"
+        "shrinkage=0.1\n").encode()
+    rng = np.random.RandomState(99)
+    for i in range(40):
+        text = _mutate(seed, rng).decode("utf-8", errors="replace")
+        try:
+            t = Tree.from_string(text)
+            assert t.num_leaves >= 1
+        except LightGBMError:
+            pass
+        except Exception as exc:  # noqa: BLE001 - the contract
+            pytest.fail(f"mutation {i}: {type(exc).__name__} escaped "
+                        f"Tree.from_string: {exc!r}")

@@ -517,6 +517,71 @@ def test_param_docs_drift_trips(tmp_path):
                for m in msgs)
 
 
+# -- family: ingress -----------------------------------------------------
+
+def test_ingress_assert_trips(tmp_path):
+    root = _tree(tmp_path, {"io/loader.py": """
+        def load(path, rows):
+            off = len(rows)
+            assert off == 10, (off, 10)
+            return rows
+    """})
+    report = run_checks(root, families=["ingress"])
+    assert any(f.rule == "ingress-assert"
+               and "LightGBMError" in f.message
+               for f in report.findings), report.findings
+
+
+def test_ingress_raw_parse_trips_on_split_tokens(tmp_path):
+    root = _tree(tmp_path, {"io/parser.py": """
+        def parse(line):
+            parts = line.split(",")
+            vals = [float(p) for p in parts]
+            first = int(parts[0])
+            return vals, first
+    """})
+    report = run_checks(root, families=["ingress"])
+    raw = [f for f in report.findings if f.rule == "ingress-raw-parse"]
+    assert len(raw) == 2, report.findings
+    assert all("io/guard" in f.message for f in raw)
+
+
+def test_ingress_raw_parse_ignores_non_token_conversions(tmp_path):
+    # config-value coercions and guard-helper routing are NOT findings
+    root = _tree(tmp_path, {
+        "io/parser.py": """
+            from .guard import feature_value
+
+            def parse(line, categorical_features):
+                cats = [int(c) for c in categorical_features]
+                parts = line.split(",")
+                vals = [feature_value(p) for p in parts]
+                return vals, cats
+
+            def convert_config(spec):
+                return int(spec)
+        """,
+        "io/guard.py": """
+            def feature_value(token):
+                t = token.strip()
+                return float(t)
+        """,
+    })
+    report = run_checks(root, families=["ingress"])
+    assert [f for f in report.findings
+            if f.rule == "ingress-raw-parse"] == [], report.findings
+
+
+def test_ingress_scoped_to_io_only(tmp_path):
+    root = _tree(tmp_path, {"serve/server.py": """
+        def parse(line):
+            assert line
+            return [float(p) for p in line.split(",")]
+    """})
+    report = run_checks(root, families=["ingress"])
+    assert report.findings == [], report.findings
+
+
 # -- the repo itself -----------------------------------------------------
 
 def test_repo_is_clean():

@@ -1,0 +1,444 @@
+"""Ingest chaos suite (docs/FAULT_TOLERANCE.md §Data boundary): the
+corpus injectors in lightgbm_tpu/testing/faults.py drive real dirt
+through the real loaders, and containment is pinned end to end.
+
+Acceptance gates (ISSUE 13):
+
+- training on a 5%-mangled file under ``bad_data_policy=quarantine``
+  BIT-MATCHES training on the clean subset, with ``bad_rows_total``
+  equal to the mangled count and every rejected line present in the
+  quarantine file with a reason;
+- ``fail_fast`` on the same file raises ``LightGBMError`` naming the
+  file, the first bad line, and the offending token;
+- serve-side malformed / oversized / non-finite payloads return
+  structured 400/413 with ZERO ``Predict::forest`` spans in the
+  request trace.
+"""
+
+import json
+import urllib.error
+import urllib.request
+from unittest import mock
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.io.guard import IngestGuard, read_quarantine
+from lightgbm_tpu.io.streaming import load_file_two_round
+from lightgbm_tpu.obs import tracing
+from lightgbm_tpu.serve import PredictServer
+from lightgbm_tpu.testing import faults
+from lightgbm_tpu.utils.log import LightGBMError
+
+pytestmark = pytest.mark.ingest_chaos
+
+GARBAGE = "##garbage##"
+
+
+def _write_train_file(path, n=400, seed=7):
+    """A TSV training file: label = f0 > 0, three informative-ish
+    features, %.6f so every reload parses bit-identically."""
+    rng = np.random.RandomState(seed)
+    rows = []
+    for _ in range(n):
+        f = rng.normal(size=3)
+        rows.append("\t".join([f"{int(f[0] > 0)}"]
+                              + [f"{v:.6f}" for v in f]))
+    path.write_text("\n".join(rows) + "\n")
+    return rows
+
+
+TRAIN_PARAMS = {"objective": "binary", "num_iterations": 5,
+                "num_leaves": 7, "min_data_in_leaf": 10,
+                "learning_rate": 0.2, "verbose": -1}
+
+
+def _train_on(path, extra_params):
+    params = {**TRAIN_PARAMS, **extra_params}
+    ds = lgb.Dataset(str(path), params=params)
+    bst = lgb.train(params, ds)
+    return bst
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance test: quarantine == clean subset, bit for bit
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("two_round", [True, False])
+def test_mangled_quarantine_bitmatches_clean_subset(tmp_path, two_round):
+    dirty = tmp_path / "train.tsv"
+    rows = _write_train_file(dirty)
+    mangled = faults.mangle_rows(str(dirty), fraction=0.05, seed=3,
+                                 token=GARBAGE)
+    assert len(mangled) == 20          # 5% of 400
+
+    clean = tmp_path / "clean.tsv"
+    keep = [r for i, r in enumerate(rows, start=1) if i not in mangled]
+    clean.write_text("\n".join(keep) + "\n")
+
+    extra = {"two_round": two_round, "bad_data_policy": "quarantine"}
+    base = obs.get_counter("bad_rows_total")
+    # both runs on the PYTHON parser: the dirty file reroutes there
+    # anyway (the native loader flags it), and the native fast-atof
+    # differs from float() by ~1 ulp — the bit-match contract is
+    # "same parser, same rows" (the documented two-round caveat,
+    # io/streaming.py module docstring)
+    with mock.patch("lightgbm_tpu.io.native.parse_file_native",
+                    return_value=None):
+        bst_dirty = _train_on(dirty, extra)
+    assert obs.get_counter("bad_rows_total") - base == len(mangled)
+
+    # every rejected line is in the quarantine file, with a reason
+    recs = read_quarantine(str(dirty))
+    assert sorted(r["line"] for r in recs) == mangled
+    assert all(r["reason"] == "unparseable_token" for r in recs)
+    assert all(GARBAGE in r["raw"] for r in recs)
+
+    with mock.patch("lightgbm_tpu.io.native.parse_file_native",
+                    return_value=None):
+        bst_clean = _train_on(clean, {"two_round": two_round})
+    assert bst_dirty._booster.save_model_to_string() == \
+        bst_clean._booster.save_model_to_string()
+
+
+@pytest.mark.parametrize("two_round", [True, False])
+def test_mangled_fail_fast_names_file_line_token(tmp_path, two_round):
+    dirty = tmp_path / "train.tsv"
+    _write_train_file(dirty)
+    mangled = faults.mangle_rows(str(dirty), fraction=0.05, seed=3,
+                                 token=GARBAGE)
+    with pytest.raises(LightGBMError) as ei:
+        _train_on(dirty, {"two_round": two_round})
+    msg = str(ei.value)
+    assert f"{dirty}:{mangled[0]}" in msg
+    assert GARBAGE in msg
+    assert "fail_fast" in msg
+
+
+def test_error_budget_stops_a_mostly_garbage_file(tmp_path):
+    dirty = tmp_path / "train.tsv"
+    _write_train_file(dirty)
+    faults.mangle_rows(str(dirty), fraction=0.5, seed=1, token=GARBAGE)
+    with pytest.raises(LightGBMError) as ei:
+        _train_on(dirty, {"bad_data_policy": "quarantine",
+                          "max_bad_row_fraction": 0.1})
+    assert "budget exhausted" in str(ei.value)
+    # absolute budget too
+    with pytest.raises(LightGBMError) as ei2:
+        _train_on(dirty, {"bad_data_policy": "quarantine",
+                          "max_bad_row_fraction": 0.0,
+                          "max_bad_rows": 5})
+    assert "max_bad_rows=5" in str(ei2.value)
+
+
+def test_ragged_and_truncated_rows_quarantined(tmp_path):
+    dirty = tmp_path / "train.tsv"
+    _write_train_file(dirty, n=120)
+    ragged = faults.ragged_rows(str(dirty), fraction=0.05, seed=2,
+                                mode="drop")
+    trunc_line = faults.truncate_mid_row(str(dirty))
+    g = IngestGuard(str(dirty), policy="quarantine")
+    ds = load_file_two_round(str(dirty), max_bin=63, min_data_in_leaf=10,
+                             guard=g)
+    want_bad = sorted(set(ragged) | {trunc_line})
+    assert sorted(r["line"] for r in read_quarantine(str(dirty))) == \
+        want_bad
+    assert ds.metadata.num_data == 120 - len(want_bad)
+
+
+def test_chunked_prediction_rows_align_with_blank_lines(tmp_path):
+    """Satellite pin: blank lines must not drift chunked prediction —
+    chunk counts ride the real parsed rows, so chunked output row
+    counts equal the whole-file parse row for row."""
+    train = tmp_path / "train.tsv"
+    _write_train_file(train)
+    bst = _train_on(train, {})
+    pred = tmp_path / "pred.tsv"
+    rng = np.random.RandomState(5)
+    lines = []
+    for i in range(30):
+        f = rng.normal(size=3)
+        lines.append("\t".join([f"{int(f[0] > 0)}"]
+                               + [f"{v:.6f}" for v in f]))
+        if i % 4 == 0:
+            lines.append("")           # interior blank lines
+    pred.write_text("\n".join(lines) + "\n\n")
+    old_chunk = type(bst)._PREDICT_CHUNK_ROWS
+    type(bst)._PREDICT_CHUNK_ROWS = 7  # force many chunks
+    try:
+        chunks = list(bst.predict_chunks(str(pred)))
+    finally:
+        type(bst)._PREDICT_CHUNK_ROWS = old_chunk
+    total = sum(c.shape[1] for c in chunks)
+    assert total == 30                 # one prediction per DATA row
+    whole = bst.predict(np.asarray(
+        [[float(v) for v in ln.split("\t")[1:]]
+         for ln in lines if ln.strip()], np.float64))
+    np.testing.assert_allclose(
+        np.concatenate([c.reshape(-1) for c in chunks]), whole,
+        rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# two-round drift: a concurrent producer mutating the file mid-load
+# ---------------------------------------------------------------------------
+
+def test_concurrent_append_is_named_drift_error(tmp_path):
+    p = tmp_path / "train.tsv"
+    _write_train_file(p, n=100)
+    with faults.concurrent_append(str(p), "1\t0.5\t0.5\t0.5\n",
+                                  after_reads=2) as st:
+        with pytest.raises(LightGBMError) as ei:
+            load_file_two_round(str(p), max_bin=63, min_data_in_leaf=10)
+    assert st["appended"]
+    assert "changed between rounds" in str(ei.value)
+    assert str(p) in str(ei.value)
+    # the file is quiescent now: the SAME call succeeds (101 rows)
+    ds = load_file_two_round(str(p), max_bin=63, min_data_in_leaf=10)
+    assert ds.metadata.num_data == 101
+
+
+# ---------------------------------------------------------------------------
+# model-artifact corruption -> clean client errors
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["truncate_tree", "chop_footer",
+                                  "garbage_field"])
+def test_corrupt_model_file_is_clean_load_error(tmp_path, mode):
+    dirty = tmp_path / "train.tsv"
+    _write_train_file(dirty)
+    bst = _train_on(dirty, {})
+    mpath = tmp_path / "model.txt"
+    bst.save_model(str(mpath))
+    what = faults.corrupt_model_file(str(mpath), mode=mode)
+    with pytest.raises(LightGBMError) as ei:
+        lgb.Booster(model_file=str(mpath))
+    msg = str(ei.value)
+    assert "model file" in msg.lower() or "Tree=" in msg, (what, msg)
+
+
+def test_corrupt_model_reload_is_400_and_keeps_serving(tmp_path):
+    dirty = tmp_path / "train.tsv"
+    _write_train_file(dirty)
+    bst = _train_on(dirty, {})
+    good = tmp_path / "good.txt"
+    bst.save_model(str(good))
+    bad = tmp_path / "bad.txt"
+    bad.write_text(good.read_text())
+    faults.corrupt_model_file(str(bad), mode="truncate_tree")
+
+    cf = bst.compile(buckets=[16, 64])
+    cf.warmup(max_bucket=64)
+    srv = PredictServer(cf, port=0, max_batch=64, max_delay_ms=1.0).start()
+    host, port = srv.address
+    base = f"http://{host}:{port}"
+    X = np.array([[0.1, -0.2, 0.3]], np.float32)
+    try:
+        gen0 = json.loads(urllib.request.urlopen(
+            base + "/healthz", timeout=10).read())["generation"]
+        req = urllib.request.Request(
+            base + "/reload", data=json.dumps(
+                {"model": str(bad)}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=60)
+        assert err.value.code == 400
+        body = json.loads(err.value.read())
+        assert "reload failed" in body["error"]
+        # generation untouched, traffic still served
+        health = json.loads(urllib.request.urlopen(
+            base + "/healthz", timeout=10).read())
+        assert health["generation"] == gen0
+        req2 = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"rows": X.tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = json.loads(urllib.request.urlopen(req2, timeout=30).read())
+        assert resp["num_rows"] == 1
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# serve ingress: malformed / oversized / non-finite payloads shed
+# before ANY device time (zero Predict::forest spans, trace-pinned)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def tracer(tmp_path, monkeypatch):
+    path = tmp_path / "trace_events.json"
+    tracing.TRACER.reset()
+    monkeypatch.setenv(tracing.ENV_PATH, str(path))
+    tracing.TRACER.configure()
+    yield path
+    tracing.TRACER.disable()
+    tracing.TRACER.reset()
+    tracing.TRACER.path = None
+
+
+def _post_expect_error(base, payload, code, body_bytes=None,
+                       timeout=30):
+    data = body_bytes if body_bytes is not None \
+        else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        base + "/predict", data=data,
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as err:
+        urllib.request.urlopen(req, timeout=timeout)
+    assert err.value.code == code, err.value.read()[:200]
+    rid = err.value.headers.get("X-Request-Id")
+    body = json.loads(err.value.read())
+    assert "error" in body
+    return int(rid), body["error"]
+
+
+def test_serve_ingress_shedding_zero_device_spans(tmp_path, tracer):
+    dirty = tmp_path / "train.tsv"
+    _write_train_file(dirty)
+    bst = _train_on(dirty, {})
+    cf = bst.compile(buckets=[16, 64])
+    cf.warmup(max_bucket=64)
+    srv = PredictServer(cf, port=0, max_batch=64, max_delay_ms=1.0,
+                        max_body_bytes=4096,
+                        nonfinite_policy="reject").start()
+    host, port = srv.address
+    base = f"http://{host}:{port}"
+    bad_ids = []
+    try:
+        # ragged width: 400 naming the offending ROW INDEX
+        rid, msg = _post_expect_error(
+            base, {"rows": [[0.1, 0.2, 0.3], [0.1, 0.2]]}, 400)
+        assert "row 1" in msg
+        bad_ids.append(rid)
+        # non-numeric element: 400 naming row + feature
+        rid, msg = _post_expect_error(
+            base, {"rows": [[0.1, 0.2, 0.3], [0.1, "x", 0.3]]}, 400)
+        assert "row 1" in msg and "non-numeric" in msg
+        bad_ids.append(rid)
+        # non-finite under reject: 400 naming the row + the policy
+        rid, msg = _post_expect_error(
+            base, {"rows": [[0.1, 0.2, 0.3],
+                            [0.1, float("nan"), 0.3]]}, 400)
+        assert "row 1" in msg and "serve_nonfinite_policy" in msg
+        bad_ids.append(rid)
+        # oversized body: 413 before parsing
+        huge = b'{"rows": [' + b"[0.1, 0.2, 0.3]," * 2000 \
+            + b"[0.1, 0.2, 0.3]]}"
+        rid, msg = _post_expect_error(base, None, 413, body_bytes=huge)
+        assert "serve_max_body_bytes" in msg
+        bad_ids.append(rid)
+        assert obs.get_counter("serve_oversize_requests") >= 1
+        # a clean request still works on the same server
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"rows": [[0.1, -0.2, 0.3]]}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        assert resp["num_rows"] == 1
+    finally:
+        srv.stop()
+    events = tracing.read_trace(str(tracer))
+    spans = [e for e in events if e.get("ph") == "X"]
+    by_request = {e["args"]["request_id"]: e["args"]["trace_id"]
+                  for e in spans if e["name"] == "Serve::request"
+                  and "request_id" in (e.get("args") or {})}
+    predict_traces = {e["args"].get("trace_id") for e in spans
+                      if e["name"] == "Predict::forest"}
+    assert len(bad_ids) == 4
+    for rid in bad_ids:
+        assert rid in by_request, f"request {rid} left no closed span"
+        assert by_request[rid] not in predict_traces, \
+            f"rejected request {rid} reached the device"
+
+
+def test_serve_malformed_content_length_is_400(tmp_path):
+    """Review pin: a non-integer Content-Length is a structured 400,
+    not an uncaught ValueError aborting the connection."""
+    import http.client
+    dirty = tmp_path / "train.tsv"
+    _write_train_file(dirty)
+    bst = _train_on(dirty, {})
+    cf = bst.compile(buckets=[16])
+    cf.warmup(max_bucket=16)
+    srv = PredictServer(cf, port=0, max_batch=16, max_delay_ms=1.0).start()
+    host, port = srv.address
+    try:
+        for path in ("/predict", "/reload"):
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            conn.putrequest("POST", path)
+            conn.putheader("Content-Type", "application/json")
+            conn.putheader("Content-Length", "abc")
+            conn.endheaders()
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            assert resp.status == 400, (path, resp.status)
+            assert "Content-Length" in body["error"]
+            conn.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# bench plumbing (satellite: bad_rows BENCH block passthrough)
+
+
+def test_bench_regress_passes_bad_rows_through(tmp_path, capsys):
+    """A candidate whose train run quarantined rows carries the
+    ``bad_rows`` block into the verdict informationally — never gated,
+    never an error when the (older) baseline lacks it."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import bench_regress
+    finally:
+        sys.path.pop(0)
+    baseline = {"metric": "boosting_iters_per_sec_x", "value": 7.0,
+                "unit": "iters/sec", "warmup_s": 30.0}
+    candidate = {"metric": "boosting_iters_per_sec_x", "value": 7.2,
+                 "unit": "iters/sec", "warmup_s": 28.0,
+                 "bad_rows": {"total": 17, "unparseable_token": 12,
+                              "ragged_row": 5}}
+    b = tmp_path / "base.json"
+    c = tmp_path / "cand.json"
+    b.write_text(json.dumps(baseline))
+    c.write_text(json.dumps(candidate))
+    rc = bench_regress.main(["--baseline", str(b), "--candidate", str(c),
+                             "--threshold", "5"])
+    assert rc == 0
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["ok"]
+    assert verdict["bad_rows_candidate"] == {"total": 17,
+                                             "unparseable_token": 12,
+                                             "ragged_row": 5}
+    assert "bad_rows_baseline" not in verdict
+
+
+def test_serve_nonfinite_propagate_reaches_the_forest(tmp_path):
+    dirty = tmp_path / "train.tsv"
+    _write_train_file(dirty)
+    bst = _train_on(dirty, {})
+    cf = bst.compile(buckets=[16, 64])
+    cf.warmup(max_bucket=64)
+    srv = PredictServer(cf, port=0, max_batch=64, max_delay_ms=1.0,
+                        nonfinite_policy="propagate").start()
+    host, port = srv.address
+    base = f"http://{host}:{port}"
+    try:
+        X = np.array([[np.nan, -0.2, 0.3]], np.float32)
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"rows": [[None if np.isnan(v) else
+                                       float(v) for v in X[0]]]}
+                            ).replace("null", "NaN").encode(),
+            headers={"Content-Type": "application/json"})
+        resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        want = cf.predict(X, device_binning=True)
+        np.testing.assert_allclose(resp["predictions"],
+                                   np.asarray(want).ravel(),
+                                   rtol=1e-6, atol=1e-6)
+    finally:
+        srv.stop()

@@ -1,0 +1,372 @@
+"""Data-boundary containment unit layer (io/guard.py + guarded parsers,
+docs/FAULT_TOLERANCE.md §Data boundary): classification vocabulary,
+fail-fast diagnostics naming file:line + token, quarantine sink format,
+error budgets, two-round dedupe, NA-as-missing semantics, and the
+blank-line chunk alignment fix."""
+
+import os
+from unittest import mock
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import obs
+from lightgbm_tpu.io.guard import (IngestGuard, column_index,
+                                   feature_value, read_quarantine)
+from lightgbm_tpu.io.parser import (_parse_delimited, _parse_libsvm,
+                                    parse_file, parse_file_chunks)
+from lightgbm_tpu.io.streaming import load_file_two_round
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _python_parse_file(path, **kw):
+    """Force the guarded Python path (native fast path mocked away)."""
+    with mock.patch("lightgbm_tpu.io.native.parse_file_native",
+                    return_value=None):
+        return parse_file(path, **kw)
+
+
+# ---------------------------------------------------------------------------
+# token helpers: the single conversion point (graftcheck ingress rules)
+# ---------------------------------------------------------------------------
+
+def test_feature_value_na_spellings_are_nan():
+    for tok in ("na", "NA", "NaN", "nan", "null", "NULL", "none", "",
+                "  "):
+        assert np.isnan(feature_value(tok)), tok
+    assert feature_value(" 1.5 ") == 1.5
+    assert feature_value("-2e3") == -2000.0
+    with pytest.raises(ValueError):
+        feature_value("1.5x")
+    with pytest.raises(ValueError):
+        feature_value("@@")
+
+
+def test_column_index_rejects_negative_and_garbage():
+    assert column_index("7") == 7
+    with pytest.raises(ValueError):
+        column_index("-2")          # the silent wrong-feature write
+    with pytest.raises(ValueError):
+        column_index("x")
+
+
+# ---------------------------------------------------------------------------
+# guard policy mechanics
+# ---------------------------------------------------------------------------
+
+def test_fail_fast_names_file_line_and_token(tmp_path):
+    g = IngestGuard(str(tmp_path / "d.csv"))
+    with pytest.raises(LightGBMError) as ei:
+        g.bad_row(42, "1,xx,3", "unparseable_token", "token 'xx'")
+    msg = str(ei.value)
+    assert "d.csv:42" in msg and "'xx'" in msg \
+        and "unparseable_token" in msg
+
+
+def test_quarantine_sink_records_and_counters(tmp_path):
+    p = str(tmp_path / "d.csv")
+    base = obs.get_counter("bad_rows_total")
+    g = IngestGuard(p, policy="quarantine")
+    assert g.bad_row(3, "1,xx,3", "unparseable_token", "token 'xx'")
+    assert g.bad_row(9, "1,2", "ragged_row", "2 fields")
+    # dedupe: the same line classified again (two-round) is a no-op
+    assert not g.bad_row(3, "1,xx,3", "unparseable_token", "token 'xx'")
+    g.finish()
+    assert g.bad_total == 2
+    assert obs.get_counter("bad_rows_total") - base == 2
+    assert obs.get_counter("bad_rows_unparseable_token") >= 1
+    assert obs.get_counter("bad_rows_ragged_row") >= 1
+    recs = read_quarantine(p)
+    assert [r["line"] for r in recs] == [3, 9]
+    assert recs[0]["reason"] == "unparseable_token"
+    assert recs[0]["raw"] == "1,xx,3"
+
+
+def test_stale_quarantine_file_removed_on_new_guard(tmp_path):
+    p = str(tmp_path / "d.csv")
+    g = IngestGuard(p, policy="quarantine")
+    g.bad_row(1, "x", "empty", "no fields")
+    g.finish()
+    assert os.path.exists(g.quarantine_path)
+    IngestGuard(p, policy="quarantine")     # fresh load, no bad rows yet
+    assert not os.path.exists(g.quarantine_path)
+
+
+def test_absolute_budget_exhaustion(tmp_path):
+    g = IngestGuard(str(tmp_path / "d.csv"), policy="quarantine",
+                    max_bad_rows=2)
+    g.bad_row(1, "a", "empty", "no fields")
+    g.bad_row(2, "b", "empty", "no fields")
+    with pytest.raises(LightGBMError) as ei:
+        g.bad_row(3, "c", "empty", "no fields")
+    assert "max_bad_rows=2" in str(ei.value)
+
+
+def test_fraction_budget_in_flight_and_at_finish(tmp_path):
+    # in flight: past the grace window, > 10% bad aborts
+    g = IngestGuard(str(tmp_path / "d.csv"), policy="quarantine",
+                    max_bad_row_fraction=0.1)
+    g.good_rows(99)
+    g.bad_row(100, "x", "empty", "no fields")   # 1/100: at the edge, ok
+    g.good_rows(900)
+    with pytest.raises(LightGBMError):
+        for i in range(200):                     # push past 10%
+            g.bad_row(2000 + i, "x", "empty", "no fields")
+    # at finish: short files get the final check
+    g2 = IngestGuard(str(tmp_path / "e.csv"), policy="quarantine",
+                     max_bad_row_fraction=0.1)
+    g2.good_rows(4)
+    g2.bad_row(5, "x", "empty", "no fields")     # 1/5 = 20%
+    with pytest.raises(LightGBMError):
+        g2.finish()
+
+
+def test_shadow_guard_skips_without_counting(tmp_path):
+    p = str(tmp_path / "d.csv")
+    base = obs.get_counter("bad_rows_total")
+    g = IngestGuard(p, policy="quarantine", record=False)
+    assert g.bad_row(3, "1,xx,3", "unparseable_token", "token 'xx'")
+    g.finish()
+    assert obs.get_counter("bad_rows_total") == base
+    assert not os.path.exists(g.quarantine_path)
+
+
+# ---------------------------------------------------------------------------
+# parser classification
+# ---------------------------------------------------------------------------
+
+def test_delimited_classification_reasons(tmp_path):
+    lines = ["1,2,3", "1,zz,3", "1,2", ",,", "1,4,5"]
+    g = IngestGuard(str(tmp_path / "d.csv"), policy="quarantine")
+    label, feats = _parse_delimited(lines, ",", 0, guard=g)
+    assert feats.shape == (2, 2)
+    assert g.by_reason == {"unparseable_token": 1, "ragged_row": 1,
+                           "empty": 1}
+
+
+def test_delimited_na_tokens_become_nan():
+    label, feats = _parse_delimited(["1,na,2", "0,3,NaN"], ",", 0)
+    assert np.isnan(feats[0, 0]) and np.isnan(feats[1, 1])
+    assert feats[0, 1] == 2.0
+
+
+def test_libsvm_bad_column_index_classified(tmp_path):
+    lines = ["1 0:1.5 2:2.5", "0 -3:9.9", "1 1:2.0"]
+    # fail fast: the negative index is a NAMED error, not a silent
+    # write into feature F-3
+    with pytest.raises(LightGBMError) as ei:
+        _parse_libsvm(lines, guard=IngestGuard("f.svm"))
+    assert "bad_column_index" in str(ei.value)
+    assert "-3" in str(ei.value)
+    # quarantine: the row is skipped, others intact
+    g = IngestGuard(str(tmp_path / "f.svm"), policy="quarantine")
+    label, feats = _parse_libsvm(lines, guard=g)
+    assert feats.shape == (2, 3)
+    assert g.by_reason == {"bad_column_index": 1}
+
+
+def test_libsvm_out_of_range_index_classified(tmp_path):
+    g = IngestGuard(str(tmp_path / "f.svm"), policy="quarantine")
+    label, feats = _parse_libsvm(["1 0:1 9:9", "0 1:2"], num_features=3,
+                                 guard=g)
+    assert feats.shape == (1, 3)
+    assert g.by_reason == {"bad_column_index": 1}
+
+
+def test_libsvm_malformed_tokens_classified(tmp_path):
+    g = IngestGuard(str(tmp_path / "f.svm"), policy="quarantine")
+    label, feats = _parse_libsvm(
+        ["1 0:1.5", "0 junk", "1 2:zz", "badlabel 0:1"], guard=g)
+    assert feats.shape == (1, 1)    # only the clean row survives
+    assert g.by_reason == {"unparseable_token": 3}
+
+
+def test_parse_file_fail_fast_is_default(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("1,2,3\n1,zz,3\n")
+    with pytest.raises(LightGBMError) as ei:
+        _python_parse_file(str(p))
+    assert f"{p}:2" in str(ei.value) and "'zz'" in str(ei.value)
+    # the native path must reroute to the same diagnostic
+    with pytest.raises(LightGBMError) as ei2:
+        parse_file(str(p))
+    assert f"{p}:2" in str(ei2.value)
+
+
+def test_parse_file_quarantine_line_numbers_with_header(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("lab,a,b\n1,2,3\n\n1,zz,3\n1,4,5\n")
+    g = IngestGuard(str(p), policy="quarantine")
+    label, feats, header = _python_parse_file(str(p), has_header=True,
+                                              guard=g)
+    assert header == ["a", "b"]
+    assert feats.shape == (2, 2)
+    # physical line number: header=1, blank line counted, bad row at 4
+    assert [r["line"] for r in read_quarantine(str(p))] == [4]
+
+
+def test_undecodable_bytes_are_classified_not_crashed(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_bytes(b"1,2,3\n1,\xff\xfe,3\n1,4,5\n")
+    with pytest.raises(LightGBMError):
+        _python_parse_file(str(p))
+    g = IngestGuard(str(p), policy="quarantine")
+    _, feats, _ = _python_parse_file(str(p), guard=g)
+    assert feats.shape == (2, 2)
+
+
+# ---------------------------------------------------------------------------
+# blank-line chunk alignment (satellite: chunked-vs-whole parity)
+# ---------------------------------------------------------------------------
+
+def test_parse_file_chunks_blank_lines_do_not_drift(tmp_path):
+    p = tmp_path / "b.csv"
+    rows = []
+    for i in range(10):
+        rows.append(f"{i % 2},{i},.5")
+        if i % 3 == 0:
+            rows.append("")        # interior blank lines
+    p.write_text("\n".join(rows) + "\n\n")
+    whole_label, whole_X, _ = _python_parse_file(str(p))
+    # tiny chunk size: blanks land on chunk boundaries
+    got = list(parse_file_chunks(str(p), chunk_rows=2))
+    X = np.concatenate([x for _, x in got], axis=0)
+    lab = np.concatenate([l for l, _ in got])
+    assert X.shape == whole_X.shape == (10, 2)
+    np.testing.assert_array_equal(X, whole_X)
+    np.testing.assert_array_equal(lab, whole_label)
+
+
+def test_parse_file_chunks_fail_fast_names_line(tmp_path):
+    p = tmp_path / "b.csv"
+    p.write_text("1,2,3\n\n1,zz,3\n")
+    with pytest.raises(LightGBMError) as ei:
+        list(parse_file_chunks(str(p), chunk_rows=1))
+    assert f"{p}:3" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# two-round loader accounting
+# ---------------------------------------------------------------------------
+
+def _write_tsv(path, n=60, bad_lines=(), seed=0):
+    rng = np.random.RandomState(seed)
+    rows = []
+    for i in range(n):
+        vals = [f"{int(rng.rand() < 0.5)}"] + \
+            [f"{v:.6f}" for v in rng.normal(size=3)]
+        rows.append("\t".join(vals))
+    for ln in bad_lines:
+        rows[ln - 1] = rows[ln - 1] + "\t@@junk@@"
+    path.write_text("\n".join(rows) + "\n")
+    return rows
+
+
+def test_two_round_quarantine_crops_and_dedupes(tmp_path):
+    p = tmp_path / "t.tsv"
+    _write_tsv(p, n=60, bad_lines=(7, 41))
+    base = obs.get_counter("bad_rows_total")
+    g = IngestGuard(str(p), policy="quarantine")
+    ds = load_file_two_round(str(p), max_bin=15, min_data_in_leaf=5,
+                             guard=g, chunk_rows=13)
+    assert ds.bins.shape[1] == 58
+    assert ds.metadata.num_data == 58
+    assert len(ds.metadata.label) == 58
+    # sampled in round 1b AND re-met in round 2: counted ONCE
+    assert obs.get_counter("bad_rows_total") - base == 2
+    assert sorted(r["line"] for r in read_quarantine(str(p))) == [7, 41]
+
+
+def test_two_round_all_rows_bad_is_named(tmp_path):
+    p = tmp_path / "t.tsv"
+    p.write_text("a\tb\nx\ty\n")
+    g = IngestGuard(str(p), policy="quarantine")
+    with pytest.raises(LightGBMError) as ei:
+        load_file_two_round(str(p), guard=g)
+    assert "quarantined" in str(ei.value)
+
+
+def test_two_round_ragged_sampled_first_cannot_invert_schema(tmp_path):
+    """Review pin: the expected field count is seeded from the file's
+    FIRST data line (the native loader's schema rule), never from
+    whichever line round 1b happens to sample first — one ragged line
+    must not flip classification for the whole file."""
+    p = tmp_path / "t.tsv"
+    rows = _write_tsv(p, n=150, bad_lines=())
+    # make line 2 ragged (drops a field); with a small sample it could
+    # be the first line the guard parses
+    rows[1] = "\t".join(rows[1].split("\t")[:3])
+    p.write_text("\n".join(rows) + "\n")
+    g = IngestGuard(str(p), policy="quarantine")
+    ds = load_file_two_round(str(p), max_bin=15, min_data_in_leaf=5,
+                             bin_construct_sample_cnt=5,
+                             data_random_seed=1, guard=g)
+    # exactly ONE row quarantined — the ragged one, not the other 149
+    assert g.by_reason == {"ragged_row": 1}
+    assert ds.metadata.num_data == 149
+
+
+def test_two_round_sampled_good_rows_counted_once_in_budget(tmp_path):
+    """Review pin: round-1b sample lines reappear in round 2; good rows
+    must not double-count in the fractional budget's denominator."""
+    p = tmp_path / "t.tsv"
+    _write_tsv(p, n=120, bad_lines=(5,))
+    g = IngestGuard(str(p), policy="quarantine")
+    load_file_two_round(str(p), max_bin=15, min_data_in_leaf=5,
+                        bin_construct_sample_cnt=120, guard=g)
+    assert g.rows_seen == 120       # NOT 239
+    assert g.bad_total == 1
+
+
+def test_quarantine_refuses_row_aligned_side_files(tmp_path):
+    """Review pin: a .weight/.query/.init companion is positional —
+    quarantined rows make it un-alignable, so the load refuses with a
+    named error instead of silently shifting every later value."""
+    p = tmp_path / "t.tsv"
+    _write_tsv(p, n=60, bad_lines=(7,))
+    (tmp_path / "t.tsv.weight").write_text(
+        "\n".join("1.0" for _ in range(60)) + "\n")
+    g = IngestGuard(str(p), policy="quarantine")
+    with pytest.raises(LightGBMError) as ei:
+        load_file_two_round(str(p), max_bin=15, min_data_in_leaf=5,
+                            guard=g)
+    assert ".weight" in str(ei.value)
+    assert "re-align" in str(ei.value)
+    # clean file + side file: loads fine
+    p2 = tmp_path / "c.tsv"
+    _write_tsv(p2, n=60)
+    (tmp_path / "c.tsv.weight").write_text(
+        "\n".join("1.0" for _ in range(60)) + "\n")
+    ds = load_file_two_round(str(p2), max_bin=15, min_data_in_leaf=5)
+    assert ds.metadata.weights is not None
+
+
+def test_native_degenerate_tokens_flagged(tmp_path):
+    """Review pin: '-', '.', '1e', '2e+' are NOT numbers — the native
+    loader must flag them (Python classifies them), not parse phantom
+    values, while '.5' / '-1.5e+2' / '3e2' stay valid."""
+    from lightgbm_tpu.io.native import get_lib, parse_file_native
+    if get_lib() is None:
+        pytest.skip("native toolchain unavailable")
+    for tok in ("-", ".", "-.", "1e", "2e+"):
+        p = tmp_path / "deg.csv"
+        p.write_text(f"1,0.5,2\n0,{tok},3\n")
+        assert parse_file_native(str(p))[3] == 2, tok
+    p = tmp_path / "ok.csv"
+    p.write_text("1,.5,2\n0,-1.5e+2,3e2\n")
+    y, X, _, bad = parse_file_native(str(p))
+    assert bad == -1
+    np.testing.assert_allclose(X, [[0.5, 2.0], [-150.0, 300.0]])
+
+
+def test_two_round_libsvm_bad_index_cannot_inflate_features(tmp_path):
+    p = tmp_path / "t.svm"
+    lines = [f"{i % 2} 0:{i}.5 2:{i}.25" for i in range(1, 40)]
+    lines[10] = "1 999999:zz"     # garbage value on an absurd index
+    p.write_text("\n".join(lines) + "\n")
+    g = IngestGuard(str(p), policy="quarantine")
+    ds = load_file_two_round(str(p), max_bin=15, min_data_in_leaf=5,
+                             guard=g)
+    assert ds.num_total_features == 3      # NOT 1e6
+    assert ds.metadata.num_data == 38
+    assert g.by_reason == {"unparseable_token": 1}

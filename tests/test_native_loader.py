@@ -36,7 +36,8 @@ def _python_parse(path, **kw):
     f"{REF}/parallel_learning/binary.test",
 ])
 def test_native_matches_python_on_reference_files(path):
-    y_n, X_n, _ = parse_file_native(path)
+    y_n, X_n, _, bad = parse_file_native(path)
+    assert bad == -1
     y_p, X_p, _ = _python_parse(path)
     assert X_n.shape == X_p.shape
     np.testing.assert_allclose(y_n, y_p, rtol=1e-12)
@@ -47,7 +48,8 @@ def test_native_matches_python_on_reference_files(path):
 def test_native_no_trailing_newline(tmp_path):
     p = tmp_path / "d.csv"
     p.write_text("1,0.5,2\n0,1.5,3")  # last line unterminated
-    y, X, _ = parse_file_native(str(p))
+    y, X, _, bad = parse_file_native(str(p))
+    assert bad == -1
     np.testing.assert_allclose(y, [1, 0])
     np.testing.assert_allclose(X, [[0.5, 2.0], [1.5, 3.0]])
 
@@ -59,8 +61,9 @@ def test_native_libsvm_label_less_rows(tmp_path):
     # must NOT swallow the first pair.
     p = tmp_path / "d.svm"
     p.write_text("0:1.5 2:2.5\n1:3.5\n")
-    y_n, X_n, fmt = parse_file_native(str(p))
+    y_n, X_n, fmt, bad = parse_file_native(str(p))
     assert fmt == "libsvm"
+    assert bad == -1
     y_p, X_p, _ = _python_parse(str(p))
     assert X_n.shape == X_p.shape == (2, 3)
     np.testing.assert_allclose(y_n, [0.0, 0.0])
@@ -103,14 +106,54 @@ def test_values_to_bins_u16():
 
 @needs_native
 def test_native_nan_token_and_no_label(tmp_path):
+    # NA spellings and empty fields are MISSING values (NaN) — the
+    # reference's parser semantics, mirrored by io/guard.feature_value;
+    # the bin mappers route NaN to bin 0 (test_binning_nan_goes_to_bin_zero)
     p = tmp_path / "d.csv"
-    p.write_text("1,nan,2\n0,3,na\n")
-    y, X, _ = parse_file_native(str(p))
-    np.testing.assert_allclose(X, [[0.0, 2.0], [3.0, 0.0]])
+    p.write_text("1,nan,2\n0,3,na\n1,NULL,\n")
+    y, X, _, bad = parse_file_native(str(p))
+    assert bad == -1          # NA tokens are clean input, not dirt
+    np.testing.assert_allclose(
+        X, [[np.nan, 2.0], [3.0, np.nan], [np.nan, np.nan]])
     # label_idx=-1: no label column, all columns are features
-    y2, X2, _ = parse_file_native(str(p), label_idx=-1)
-    np.testing.assert_allclose(y2, [0.0, 0.0])
-    assert X2.shape == (2, 3)
+    y2, X2, _, _ = parse_file_native(str(p), label_idx=-1)
+    np.testing.assert_allclose(y2, [0.0, 0.0, 0.0])
+    assert X2.shape == (3, 3)
+
+
+@needs_native
+def test_native_na_parity_with_python(tmp_path):
+    """Native-vs-Python parser parity on a file containing NA tokens:
+    both must emit NaN for na/NaN/NULL/none and empty fields."""
+    p = tmp_path / "na.csv"
+    p.write_text("1,na,2.5\n0,3.5,NaN\n1,NULL,none\n0,,4.5\n")
+    y_n, X_n, _, bad = parse_file_native(str(p))
+    assert bad == -1
+    y_p, X_p, _ = _python_parse(str(p))
+    np.testing.assert_allclose(y_n, y_p)
+    np.testing.assert_allclose(X_n, X_p)
+    assert np.isnan(X_p[0, 0]) and np.isnan(X_p[1, 1])
+    assert np.isnan(X_p[2, 0]) and np.isnan(X_p[2, 1])
+    assert np.isnan(X_p[3, 0])
+
+
+@needs_native
+def test_native_flags_malformed_rows(tmp_path):
+    """The native loader reports the first malformed row instead of
+    silently parsing garbage to 0.0 — the flag is what reroutes dirty
+    files through the guarded Python path."""
+    p = tmp_path / "dirty.csv"
+    p.write_text("1,0.5,2\n0,xx,3\n1,4,5\n")
+    assert parse_file_native(str(p))[3] == 2
+    r = tmp_path / "ragged.csv"
+    r.write_text("1,0.5,2\n0,3\n")
+    assert parse_file_native(str(r))[3] == 2
+    s = tmp_path / "neg.svm"
+    s.write_text("1 0:1.5\n0 -2:3.0\n")
+    assert parse_file_native(str(s))[3] == 2
+    c = tmp_path / "clean.csv"
+    c.write_text("1,0.5,2\n0,1.5,3\n")
+    assert parse_file_native(str(c))[3] == -1
 
 
 def test_binning_nan_goes_to_bin_zero():

@@ -179,6 +179,13 @@ def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
         avail = obj.get("availability")
         if isinstance(avail, dict) and avail:
             verdict[f"availability_{side}"] = avail
+        # PR 13: a train run that QUARANTINED bad rows says so in the
+        # verdict — a throughput number over a partially-skipped
+        # dataset carries its asterisk, but dirt volume is data-
+        # dependent, so never gated
+        bad = obj.get("bad_rows")
+        if isinstance(bad, dict) and bad:
+            verdict[f"bad_rows_{side}"] = bad
     return verdict
 
 

@@ -11,6 +11,8 @@ locks      lock-order         one global lock order (deadlock freedom)
 tracer     jit-host-effect    no host side effects baked at trace time
 jit        jit-raw            every jit in the compile ledger
            jit-closure        no function-identity cache defeats
+ingress    ingress-assert     io/ invariants raise LightGBMError
+           ingress-raw-parse  file tokens parse via io/guard helpers
 lifecycle  thread-lifecycle   threads daemonized or joined
            handle-close       sockets/servers/files have a close path
            wall-clock         monotonic clocks on deadline math
@@ -19,4 +21,5 @@ params     param-docs         config params documented + rendered
 ========== ================== ==========================================
 """
 
-from . import jit, lifecycle, locks, params, phases, tracer  # noqa: F401
+from . import (ingress, jit, lifecycle, locks, params,  # noqa: F401
+               phases, tracer)

@@ -1,0 +1,152 @@
+"""Data-ingress containment rules (family ``ingress``).
+
+The data boundary (``lightgbm_tpu/io/``) is where external bytes become
+training state; PR 13's containment layer (io/guard.py,
+docs/FAULT_TOLERANCE.md §Data boundary) only holds if every invariant
+failure is a *named* ``LightGBMError`` and every token conversion is
+*classified*.  Two rules keep future PRs honest:
+
+``ingress-assert`` — a bare ``assert`` anywhere under ``io/`` is a
+finding.  Data-dependent invariants (row counts, offsets, widths) fail
+on dirty FILES, not buggy code; an assert gives the operator a stack
+trace instead of a file:line diagnostic, and vanishes entirely under
+``python -O``.  Raise ``LightGBMError`` (or ``log.fatal``) instead.
+
+``ingress-raw-parse`` — a raw ``float()``/``int()`` applied to a file
+token (a value derived from ``.split()``/``.partition()``/
+``.splitlines()``/``.readline()``/``.read()`` within the same function)
+outside the ``io/guard.py`` helpers is a finding.  Raw conversions
+throw bare ``ValueError`` with no file/line/token context and hard-code
+their own NA semantics; ``guard.feature_value`` / ``guard.column_index``
+are the single conversion point the quarantine policy hangs off.
+``io/guard.py`` itself is exempt — it IS the helper layer.
+
+The taint tracking is intraprocedural and syntactic (assignments,
+tuple unpacks, for-targets, and comprehension targets seeded from the
+string-splitting calls above, propagated through subscripts/attributes
+of tainted names) — cheap, zero false positives on config-string
+parsing in ``io/column_roles.py``, and exactly sharp enough to catch
+the pattern that used to live in ``io/parser.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..core import Finding, Project, family
+
+#: method calls whose results are file-token sources
+_SPLIT_METHODS = {"split", "rsplit", "partition", "rpartition",
+                  "splitlines", "readline", "readlines", "read"}
+
+#: conversion builtins that must route through the guard helpers
+_RAW_CONVERSIONS = {"float", "int"}
+
+_GUARD_MODULE = "io/guard.py"
+
+
+def _is_split_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SPLIT_METHODS)
+
+
+def _expr_has_split(node: ast.AST) -> bool:
+    return any(_is_split_call(n) for n in ast.walk(node))
+
+
+def _expr_taints(node: ast.AST, tainted: Set[str]) -> bool:
+    """Does evaluating ``node`` touch a token source — a splitting call
+    or an already-tainted name?"""
+    for n in ast.walk(node):
+        if _is_split_call(n):
+            return True
+        if isinstance(n, ast.Name) and n.id in tainted:
+            return True
+    return False
+
+
+def _target_names(target: ast.AST) -> List[str]:
+    names: List[str] = []
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name):
+            names.append(n.id)
+    return names
+
+
+def _function_findings(fn: ast.AST, rel: str) -> List[Finding]:
+    """Two fixpoint-ish passes: collect tainted names, then flag raw
+    conversions whose arguments reference them.  Nested functions are
+    walked as part of their parent (their names share the closure)."""
+    tainted: Set[str] = set()
+    for _ in range(2):      # second pass catches forward references
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                if _expr_taints(node.value, tainted):
+                    for t in node.targets:
+                        tainted.update(_target_names(t))
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                if _expr_taints(node.value, tainted):
+                    tainted.update(_target_names(node.target))
+            elif isinstance(node, ast.For):
+                if _expr_taints(node.iter, tainted):
+                    tainted.update(_target_names(node.target))
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.GeneratorExp, ast.DictComp)):
+                for gen in node.generators:
+                    if _expr_taints(gen.iter, tainted):
+                        tainted.update(_target_names(gen.target))
+    findings: List[Finding] = []
+    if not tainted:
+        return findings
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in _RAW_CONVERSIONS):
+            continue
+        if any(_expr_taints(arg, tainted) for arg in node.args):
+            findings.append(Finding(
+                "ingress-raw-parse", rel, node.lineno,
+                f"raw {node.func.id}() on a file token — route it "
+                f"through io/guard.py (feature_value/column_index) so "
+                f"malformed tokens are classified and quarantinable "
+                f"instead of raising a bare ValueError"))
+    return findings
+
+
+@family("ingress")
+def check_ingress(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    io_prefix = f"{project.pkg_rel}/io/"
+    for mod in project.modules:
+        if not mod.rel.startswith(io_prefix):
+            continue
+        # -- ingress-assert: io/ invariants must be named errors -------
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assert):
+                findings.append(Finding(
+                    "ingress-assert", mod.rel, node.lineno,
+                    "bare assert at the data boundary — a data-"
+                    "dependent invariant must raise LightGBMError "
+                    "(named file/line diagnostics, survives python -O)"))
+        # -- ingress-raw-parse: conversions through the guard only -----
+        if mod.rel.endswith(_GUARD_MODULE):
+            continue            # the helper layer itself
+        # module-level statements count as one scope; functions each
+        # get their own taint universe
+        funcs = [n for n in ast.walk(mod.tree)
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))]
+        # skip nested functions (already walked via their parent)
+        tops: List[ast.AST] = []
+        nested: Set[int] = set()
+        for f in funcs:
+            for inner in ast.walk(f):
+                if inner is not f and isinstance(
+                        inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    nested.add(id(inner))
+        tops = [f for f in funcs if id(f) not in nested]
+        for f in tops:
+            findings.extend(_function_findings(f, mod.rel))
+    return findings
