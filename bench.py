@@ -50,6 +50,35 @@ def make_higgs_like(num_data: int, num_features: int = 28, seed: int = 42):
     return X.astype(np.float64), y
 
 
+def make_ctr_like(num_data: int, num_features: int = 2000,
+                  block_size: int = 20, seed: int = 9):
+    """Wide-sparse CTR-style synthetic: one-hot-ish blocks so real
+    exclusive bundles exist (docs/SPARSE.md).
+
+    Features come in blocks of ``block_size``; each row activates at most
+    ONE feature per block (a categorical one-hot) with a small integer
+    level value, so features within a block are perfectly mutually
+    exclusive — exactly what EFB packs — and overall sparsity lands
+    around 95-97%.  The label is a logistic read-out of a sparse subset
+    of (feature, level) weights plus noise."""
+    rng = np.random.RandomState(seed)
+    num_blocks = max(num_features // block_size, 1)
+    F = num_blocks * block_size
+    X = np.zeros((num_data, F))
+    logit = rng.normal(scale=0.6, size=num_data)
+    w = rng.normal(scale=1.0, size=F) * (rng.rand(F) < 0.15)
+    idx = np.arange(num_data)
+    for b in range(num_blocks):
+        act = rng.rand(num_data) < 0.6          # block fires on 60% of rows
+        choice = b * block_size + rng.randint(0, block_size, num_data)
+        level = rng.randint(1, 5, num_data).astype(np.float64)
+        rows = idx[act]
+        X[rows, choice[act]] = level[act]
+        logit[rows] += w[choice[act]] * level[act] * 0.25
+    y = (logit > np.median(logit)).astype(np.float32)
+    return X, y
+
+
 def _fleet_scaling(booster, X32: np.ndarray, concurrency: int) -> dict:
     """``--concurrency N``: threaded closed-loop clients against the
     serving fleet at every replica count 1..len(local_devices) — the
@@ -228,7 +257,7 @@ def predict_main(concurrency: int = 0) -> None:
           f"{tail}", file=sys.stderr)
 
 
-def main() -> None:
+def main(dataset: str = "higgslike") -> None:
     num_data = int(os.environ.get("BENCH_ROWS", 1_000_000))
     num_warmup = int(os.environ.get("BENCH_WARMUP", 5))
     num_timed = int(os.environ.get("BENCH_ITERS", 30))
@@ -250,13 +279,47 @@ def main() -> None:
     from lightgbm_tpu.io.dataset import BinnedDataset
     from lightgbm_tpu.models.gbdt import GBDT
 
-    X, y = make_higgs_like(num_data)
-    cfg = Config({"objective": "binary", "metric": "auc",
-                  "num_leaves": 63, "max_bin": 255, "learning_rate": 0.1,
-                  "min_data_in_leaf": 50,
-                  "num_iterations": num_warmup + num_windows * num_timed})
+    params = {"objective": "binary", "metric": "auc",
+              "num_leaves": 63, "max_bin": 255, "learning_rate": 0.1,
+              "min_data_in_leaf": 50,
+              "num_iterations": num_warmup + num_windows * num_timed}
+    bin_kwargs = {}
+    if dataset == "ctrlike":
+        # wide-sparse mode (docs/SPARSE.md §Bench recipe): ~500k x 2000
+        # at ~95% sparsity with one-hot blocks, so real exclusive
+        # bundles exist.  BENCH_ENABLE_BUNDLE / BENCH_SCREEN_RATIO toggle
+        # the two wide-sparse optimizations for A/B BENCH runs compared
+        # by tools/bench_regress.py.
+        num_data = int(os.environ.get("BENCH_CTR_ROWS", 500_000))
+        num_feat = int(os.environ.get("BENCH_CTR_FEATURES", 2000))
+        enable_bundle = os.environ.get(
+            "BENCH_ENABLE_BUNDLE", "1").lower() in ("1", "true", "yes")
+        screen_ratio = float(os.environ.get("BENCH_SCREEN_RATIO", "0"))
+        X, y = make_ctr_like(num_data, num_feat)
+        params.update({
+            "enable_bundle": enable_bundle,
+            "feature_screen_ratio": screen_ratio,
+            "feature_screen_warmup": int(os.environ.get(
+                "BENCH_SCREEN_WARMUP", num_warmup)),
+            "feature_screen_refresh": int(os.environ.get(
+                "BENCH_SCREEN_REFRESH", 10)),
+        })
+        bin_kwargs = {"enable_bundle": enable_bundle,
+                      # bound the host sample: 2000 f64 columns x 200k
+                      # sampled rows would be 3.2 GB of transient RAM
+                      "bin_construct_sample_cnt": int(os.environ.get(
+                          "BENCH_CTR_SAMPLE", 50_000))}
+        metric_name = (f"boosting_iters_per_sec_ctrlike"
+                       f"{num_data // 1000}k_{X.shape[1]}f_"
+                       "63leaves_255bins_binary")
+    else:
+        X, y = make_higgs_like(num_data)
+        metric_name = (f"boosting_iters_per_sec_higgslike"
+                       f"{num_data // 1000}k_63leaves_255bins_binary")
+    cfg = Config(params)
     t0 = time.time()
-    ds = BinnedDataset.from_matrix(X, y, max_bin=255, min_data_in_leaf=50)
+    ds = BinnedDataset.from_matrix(X, y, max_bin=255, min_data_in_leaf=50,
+                                   **bin_kwargs)
     t_bin = time.time() - t0
 
     booster = GBDT(cfg, ds)
@@ -277,7 +340,10 @@ def main() -> None:
     # for the stderr `windows=` diagnostic (load drift over time is the
     # signal a pre-sorted list destroys)
     iters_per_sec = statistics.median(rates)
-    base = CPU_REF_ITERS_PER_SEC.get(num_data)
+    # the CPU reference numbers are higgslike-only: a ctrlike run whose
+    # row count happens to collide must not compare across workloads
+    base = (CPU_REF_ITERS_PER_SEC.get(num_data)
+            if dataset == "higgslike" else None)
     vs = (iters_per_sec / base) if base else None
     auc = booster.eval_metrics().get("training", {}).get("auc")
 
@@ -299,8 +365,7 @@ def main() -> None:
     warm_events = compile_ledger.events()[n_cold_events:]
 
     bench_json = {
-        "metric": f"boosting_iters_per_sec_higgslike{num_data // 1000}k_"
-                  "63leaves_255bins_binary",
+        "metric": metric_name,
         "value": round(iters_per_sec, 4),
         "unit": "iters/sec",
         "vs_baseline": round(vs, 4) if vs is not None else None,
@@ -311,6 +376,33 @@ def main() -> None:
         "spread": [round(min(rates), 4), round(max(rates), 4)],
         "compile_events": compile_ledger.summary(5),
     }
+    if auc is not None:
+        bench_json["auc"] = round(float(auc), 5)
+    if dataset == "ctrlike":
+        # wide-sparse bill (docs/SPARSE.md): how far EFB shrank the
+        # feature space and what screening kept active — informational
+        # BENCH keys, passed through by bench_regress
+        from lightgbm_tpu import obs as _obs2
+        plan = ds.bundle_plan
+        bench_json["efb"] = {
+            "enabled": bool(params["enable_bundle"]),
+            "num_features": int(ds.num_features),
+            "columns": int(ds.num_columns),
+            "bundles": len(plan.bundles) if plan is not None else 0,
+            "features_bundled": (plan.features_bundled
+                                 if plan is not None else 0),
+            "sample_conflicts": (plan.sample_conflicts
+                                 if plan is not None else 0),
+        }
+        bench_json["screening"] = {
+            "ratio": float(params["feature_screen_ratio"]),
+            "refresh": int(params["feature_screen_refresh"]),
+            "warmup": int(params["feature_screen_warmup"]),
+            "active_features_last": int(
+                _obs2.get_gauge("screen_active_features") or 0),
+            "refresh_total": int(
+                _obs2.get_counter("screen_refresh_total")),
+        }
     # data-boundary bill (PR 13, io/guard.py): when a file-fed run
     # quarantined rows, say so in the BENCH JSON — a throughput number
     # from a partially-skipped dataset must carry its asterisk
@@ -377,4 +469,6 @@ if __name__ == "__main__":
             sys.argv[1:], "concurrency",
             os.environ.get("BENCH_PREDICT_CONCURRENCY", "0"))))
     else:
-        main()
+        main(dataset=_parse_opt(sys.argv[1:], "dataset",
+                                os.environ.get("BENCH_DATASET",
+                                               "higgslike")))

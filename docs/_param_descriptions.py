@@ -163,17 +163,36 @@ DESC = {
                              "(-1 = all)",
     "is_pre_partition": "distributed: data files are already partitioned "
                         "per machine (accepted for conf compatibility)",
-    "is_enable_sparse": "enable sparse-aware histogram optimizations "
-                        "(accepted for conf compatibility; the TPU bin "
-                        "matrix is dense)",
+    "is_enable_sparse": "enable sparse-aware optimizations: false also "
+                        "disables EFB bundling candidate selection (the "
+                        "TPU bin matrix itself stays dense either way)",
     "is_save_binary_file": "save the parsed dataset as a binary sidecar "
                            "for faster reloads",
     "enable_load_from_binary_file": "load the binary sidecar when present "
                                     "instead of re-parsing text",
-    "max_conflict_rate": "feature bundling: max share of conflicting rows "
-                         "allowed in one bundle (EFB)",
+    "max_conflict_rate": "EFB: max share of conflicting rows (both "
+                         "features non-default) a bundle may absorb, in "
+                         "[0, 1); 0 bundles only perfectly exclusive "
+                         "features (docs/SPARSE.md)",
     "enable_bundle": "bundle mutually-exclusive sparse features into "
-                     "single columns (EFB)",
+                     "shared columns (EFB, io/bundling.py): the device "
+                     "bin matrix and histogram pass shrink from F to "
+                     "F_bundled while trees/models stay in original "
+                     "feature space (docs/SPARSE.md)",
+    "feature_screen_ratio": "EMA-FS gain screening: share of the feature "
+                            "space masked out of screened rounds by the "
+                            "split-gain EWMA (0 = off; screened rounds "
+                            "also compact the histogram pass to the "
+                            "active columns; docs/SPARSE.md)",
+    "feature_screen_refresh": "screening: every K-th post-warmup round "
+                              "scans the FULL feature set so dormant "
+                              "features can re-enter; the active set is "
+                              "re-drawn once per period",
+    "feature_screen_warmup": "screening: unscreened warm-up rounds that "
+                             "seed the per-feature gain EWMA before any "
+                             "mask applies",
+    "feature_screen_decay": "screening: per-round EWMA decay of realized "
+                            "split gains (closer to 1 = longer memory)",
     "weight_column": "per-row weight column index/name in the data file",
     "group_column": "query/group column index/name (lambdarank)",
     "histogram_pool_size": "reference histogram cache budget in MB "

@@ -170,7 +170,10 @@ class Dataset:
                     group_idx=(file_roles.group_idx
                                if file_roles is not None else -1),
                     data_random_seed=cfg_probe.data_random_seed,
-                    reference=ref)
+                    reference=ref,
+                    enable_bundle=bool(cfg_probe.enable_bundle),
+                    max_conflict_rate=float(cfg_probe.max_conflict_rate),
+                    is_enable_sparse=bool(cfg_probe.is_enable_sparse))
                 data = None
             else:
                 from .io.guard import IngestGuard
@@ -239,7 +242,10 @@ class Dataset:
                 ignore_features=(file_roles.ignore
                                  if file_roles is not None else ()),
                 feature_names=feature_name,
-                data_random_seed=cfg.data_random_seed)
+                data_random_seed=cfg.data_random_seed,
+                enable_bundle=bool(cfg.enable_bundle),
+                max_conflict_rate=float(cfg.max_conflict_rate),
+                is_enable_sparse=bool(cfg.is_enable_sparse))
         md = self._binned.metadata
         if self.label is not None and self.used_indices is None:
             md.set_label(np.asarray(self.label))

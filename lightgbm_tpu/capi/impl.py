@@ -241,7 +241,10 @@ def _binned_from_matrix(X: np.ndarray, params: Dict[str, str],
         X, None, max_bin=cfg.max_bin, min_data_in_bin=cfg.min_data_in_bin,
         min_data_in_leaf=cfg.min_data_in_leaf,
         bin_construct_sample_cnt=cfg.bin_construct_sample_cnt,
-        categorical_features=[], data_random_seed=cfg.data_random_seed)
+        categorical_features=[], data_random_seed=cfg.data_random_seed,
+        enable_bundle=bool(cfg.enable_bundle),
+        max_conflict_rate=float(cfg.max_conflict_rate),
+        is_enable_sparse=bool(cfg.is_enable_sparse))
 
 
 def _csr_to_dense(indptr, indptr_type, indices, data, data_type,

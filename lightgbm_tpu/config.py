@@ -140,6 +140,15 @@ _DEFAULTS: Dict[str, Any] = {
     "min_data_in_bin": 5,
     "max_conflict_rate": 0.0,
     "enable_bundle": True,
+    # gain-informed feature screening (EMA-FS; models/screening.py,
+    # docs/SPARSE.md) — off unless feature_screen_ratio > 0
+    "feature_screen_ratio": 0.0,    # share of feature space masked out of
+                                    # screened rounds (0 = off)
+    "feature_screen_refresh": 10,   # full-feature refresh round period
+    "feature_screen_warmup": 20,    # unscreened warm-up rounds seeding
+                                    # the gain EWMA
+    "feature_screen_decay": 0.9,    # per-round EWMA decay of realized
+                                    # split gains
     "has_header": False,
     "label_column": "",
     "weight_column": "",
@@ -398,6 +407,23 @@ class Config:
                 "(expected none, fail_fast, or skip_tree)")
         if v["snapshot_freq"] < 0:
             raise ValueError("snapshot_freq must be >= 0")
+        if not (0.0 <= v["max_conflict_rate"] < 1.0):
+            raise ValueError(
+                "max_conflict_rate must be in [0, 1): it bounds the share "
+                "of conflicting rows an EFB bundle may absorb (0 = only "
+                "perfectly exclusive features bundle)")
+        if not (0.0 <= v["feature_screen_ratio"] < 1.0):
+            raise ValueError(
+                "feature_screen_ratio must be in [0, 1) (0 disables "
+                "gain-informed feature screening; 1 would mask every "
+                "feature)")
+        if v["feature_screen_refresh"] < 1:
+            raise ValueError("feature_screen_refresh must be >= 1 (every "
+                             "K-th round re-scans the full feature set)")
+        if v["feature_screen_warmup"] < 0:
+            raise ValueError("feature_screen_warmup must be >= 0")
+        if not (0.0 < v["feature_screen_decay"] <= 1.0):
+            raise ValueError("feature_screen_decay must be in (0, 1]")
         if v["bad_data_policy"] not in ("fail_fast", "quarantine"):
             raise ValueError(
                 f"Unknown bad_data_policy {v['bad_data_policy']} "

@@ -1,0 +1,289 @@
+"""Exclusive feature bundling (EFB): the host-side planner.
+
+Wide-sparse workloads (CTR-style one-hot blocks) pay the full ``[F, B]``
+histogram cost for every feature even though most features are zero on
+most rows.  EFB (the reference's ``enable_bundle``/``max_conflict_rate``,
+src/io/dataset.cpp bundling pass) packs *mutually exclusive* sparse
+features — features that are rarely non-default on the same row — into
+shared columns whose bin space is partitioned into per-member sub-ranges
+(offset encoding, reference FeatureGroup style).  The device bin matrix
+shrinks from ``[F, N]`` to ``[C, N]``; histograms are built per column
+and expanded back to original-feature space before split finding
+(``ops/bundle.py``), so trees, the model text format, prediction and the
+whole serve path stay in original feature space by construction.
+
+Planner (:func:`plan_bundles`): greedy graph coloring over the mapper
+sample — candidates are non-trivial NUMERICAL features whose default bin
+is 0 (value 0 binned into bin 0 — the sparse-feature shape) with
+``sparse_rate`` >= :data:`MIN_BUNDLE_SPARSE_RATE`, ranked sparsest
+first.  A feature joins a bundle when (a) the bundle's cumulative
+conflict count (rows where both the bundle and the feature are
+non-default) stays within ``max_conflict_rate * sample_rows`` and (b)
+the bundle's total bin budget stays within ``max_bin`` (so the bundled
+columns ride the existing ``[C, max_bin]`` histogram shapes and uint8
+storage unchanged).  Conflicting rows keep the LAST member's value in
+column order — the bounded approximation EFB trades for the histogram
+savings; ``max_conflict_rate=0`` admits only perfectly exclusive
+features, which is what makes the zero-conflict bit-parity pin
+(tests/test_bundling.py) meaningful.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import log
+
+# Candidates must be at least this sparse (BinMapper.sparse_rate = share
+# of rows in the default bin).  Denser features gain little from
+# bundling and burn conflict budget.
+MIN_BUNDLE_SPARSE_RATE = 0.8
+
+
+class BundlePlan:
+    """The bundling decision: which used features share which column.
+
+    ``column_members[c]`` lists the inner (used) feature indices stored
+    in column ``c``; ``column_offsets[c]`` gives each member's offset —
+    the column slot of that member's local bin 1 — with offset 0 marking
+    an identity-encoded singleton (its column IS its own bin codes).
+    """
+
+    def __init__(self, column_members: List[List[int]],
+                 column_offsets: List[List[int]], num_features: int,
+                 sample_conflicts: int = 0):
+        self.column_members = [list(m) for m in column_members]
+        self.column_offsets = [list(o) for o in column_offsets]
+        self.num_features = int(num_features)
+        self.sample_conflicts = int(sample_conflicts)
+
+    # -- shape accessors -------------------------------------------------
+    @property
+    def num_columns(self) -> int:
+        return len(self.column_members)
+
+    @property
+    def bundles(self) -> List[List[int]]:
+        """Multi-member columns only."""
+        return [m for m in self.column_members if len(m) > 1]
+
+    @property
+    def features_bundled(self) -> int:
+        return sum(len(m) for m in self.bundles)
+
+    def signature(self) -> tuple:
+        """Cheap equality key for Dataset::CheckAlign-style alignment."""
+        return (tuple(tuple(m) for m in self.column_members),
+                tuple(tuple(o) for o in self.column_offsets))
+
+    # -- encoding --------------------------------------------------------
+    def encode_columns(self, feature_bins: Callable[[int], np.ndarray],
+                       n: int, dtype) -> np.ndarray:
+        """[C, n] column bin codes from per-feature bin codes.
+
+        ``feature_bins(inner)`` returns that used feature's original bin
+        codes for the n rows.  Bundle members write their non-default
+        bins at ``offset + bin - 1``; on a conflicting row the LAST
+        member in column order wins (deterministic)."""
+        out = np.zeros((self.num_columns, n), dtype)
+        for c, (members, offsets) in enumerate(
+                zip(self.column_members, self.column_offsets)):
+            if len(members) == 1 and offsets[0] == 0:
+                out[c] = feature_bins(members[0]).astype(dtype)
+                continue
+            col = np.zeros(n, np.int64)
+            for f, off in zip(members, offsets):
+                vb = np.asarray(feature_bins(f), np.int64)
+                nz = vb > 0          # candidates have default_bin == 0
+                col[nz] = off + vb[nz] - 1
+            out[c] = col.astype(dtype)
+        return out
+
+    # -- device decode tables (ops/bundle.py BundleDecode) ---------------
+    def decode_arrays(self, num_bins: Sequence[int],
+                      default_bins: Sequence[int], max_bin: int) -> dict:
+        """Numpy decode tables for :class:`ops.bundle.BundleDecode`.
+
+        ``num_bins``/``default_bins`` are per used original feature; the
+        slot map routes each feature's default bin (and any bin past its
+        range) to the zero slot ``max_bin`` so the expansion's integer
+        default-bin reconstruction never double-counts."""
+        F, B = self.num_features, int(max_bin)
+        col = np.zeros(F, np.int32)
+        off = np.zeros(F, np.int32)
+        width = np.zeros(F, np.int32)
+        slot_map = np.full((F, B), B, np.int32)
+        default = np.zeros(F, np.int32)
+        for c, (members, offsets) in enumerate(
+                zip(self.column_members, self.column_offsets)):
+            for f, o in zip(members, offsets):
+                nb = int(num_bins[f])
+                col[f] = c
+                off[f] = o
+                width[f] = max(nb - 1, 0)
+                default[f] = int(default_bins[f])
+                if o == 0:
+                    b = np.arange(min(nb, B))
+                    slot_map[f, b] = b
+                else:
+                    b = np.arange(1, min(nb, B + 1))
+                    slot_map[f, b] = o + b - 1
+                if 0 <= default[f] < B:
+                    slot_map[f, default[f]] = B
+        return {"col": col, "off": off, "width": width,
+                "slot_map": slot_map, "default_bin": default}
+
+    # -- serialization (binary dataset cache) ----------------------------
+    def to_state(self) -> dict:
+        return {"column_members": self.column_members,
+                "column_offsets": self.column_offsets,
+                "num_features": self.num_features,
+                "sample_conflicts": self.sample_conflicts}
+
+    @classmethod
+    def from_state(cls, state: Optional[dict]) -> Optional["BundlePlan"]:
+        if not state:
+            return None
+        return cls([list(map(int, m)) for m in state["column_members"]],
+                   [list(map(int, o)) for o in state["column_offsets"]],
+                   int(state["num_features"]),
+                   int(state.get("sample_conflicts", 0)))
+
+
+def _is_candidate(mapper) -> bool:
+    from .binning import NUMERICAL
+    return (not mapper.is_trivial
+            and mapper.bin_type == NUMERICAL
+            and mapper.default_bin == 0
+            and mapper.num_bin > 1
+            and mapper.sparse_rate >= MIN_BUNDLE_SPARSE_RATE)
+
+
+def plan_bundles(sample: np.ndarray, mappers, used_feature_map,
+                 *, max_conflict_rate: float, max_total_bin: int,
+                 enable_bundle: bool = True,
+                 is_enable_sparse: bool = True) -> Optional[BundlePlan]:
+    """Greedy conflict-bounded bundling over the mapper sample.
+
+    Args:
+      sample: [S, F_real] raw sampled rows (the same sample FindBin saw).
+      mappers: per-USED-feature BinMapper list.
+      used_feature_map: used index -> real column in ``sample``.
+      max_conflict_rate: allowed conflicting-row share per bundle.
+      max_total_bin: bin budget per bundled column (cfg.max_bin, so the
+        existing [C, max_bin] histogram shapes hold).
+    Returns a BundlePlan when at least one multi-member bundle formed,
+    else None (the dataset stays in plain per-feature layout).
+    """
+    if not enable_bundle or not is_enable_sparse or len(mappers) == 0:
+        return None
+    try:
+        from ..parallel.multihost import process_rank_world
+        if process_rank_world()[1] > 1:
+            # each rank loads its own shard: independently-drawn plans
+            # would desync the replicated feature space pod-wide
+            log.warn_once("efb_multihost",
+                          "enable_bundle: feature bundling is disabled "
+                          "under multihost loading (per-rank samples "
+                          "would draw diverging bundle plans)")
+            return None
+    except Exception:  # pragma: no cover - uninitialized backend
+        pass
+    from .. import obs
+    with obs.span("Bin::bundle"):
+        plan = _plan_bundles_impl(sample, mappers, used_feature_map,
+                                  max_conflict_rate, max_total_bin)
+    if plan is not None:
+        obs.set_gauge("efb_bundles", len(plan.bundles))
+        obs.set_gauge("efb_features_bundled", plan.features_bundled)
+        obs.set_gauge("efb_columns", plan.num_columns)
+        # the one-line dataset sparsity summary (reference-style)
+        n_sparse = sum(1 for m in mappers if _is_candidate(m))
+        log.info("EFB: %d sparse feature(s), %d bundled into %d bundle(s) "
+                 "(%d -> %d columns, %d conflicting sample rows)",
+                 n_sparse, plan.features_bundled, len(plan.bundles),
+                 plan.num_features, plan.num_columns,
+                 plan.sample_conflicts)
+    return plan
+
+
+def _plan_bundles_impl(sample, mappers, used_feature_map,
+                       max_conflict_rate, max_total_bin):
+    F = len(mappers)
+    S = sample.shape[0]
+    cand = [f for f in range(F) if _is_candidate(mappers[f])]
+    if len(cand) < 2:
+        return None
+    # sparsest first: the emptiest features pack tightest and burn the
+    # least conflict budget (the ISSUE's sparse_rate ranking)
+    cand.sort(key=lambda f: (-mappers[f].sparse_rate, f))
+    nondefault = {}
+    for f in cand:
+        col = sample[:, used_feature_map[f]]
+        nondefault[f] = np.asarray(
+            mappers[f].value_to_bin(col)) != 0
+    budget = int(float(max_conflict_rate) * S)
+
+    bundles: List[List[int]] = []       # member lists
+    occupied: List[np.ndarray] = []     # per-bundle any-member-nonzero
+    conflicts: List[int] = []           # per-bundle cumulative conflicts
+    bins_used: List[int] = []           # per-bundle 1 + sum(nb - 1)
+    for f in cand:
+        nd = nondefault[f]
+        nb = int(mappers[f].num_bin)
+        placed = False
+        for bi in range(len(bundles)):
+            if bins_used[bi] + (nb - 1) > max_total_bin:
+                continue
+            c = int(np.count_nonzero(occupied[bi] & nd))
+            if conflicts[bi] + c > budget:
+                continue
+            bundles[bi].append(f)
+            occupied[bi] |= nd
+            conflicts[bi] += c
+            bins_used[bi] += nb - 1
+            placed = True
+            break
+        if not placed:
+            bundles.append([f])
+            occupied.append(nd.copy())
+            conflicts.append(0)
+            bins_used.append(1 + (nb - 1))
+    keep = {}
+    total_conflicts = 0
+    for bi, members in enumerate(bundles):
+        if len(members) > 1:
+            for f in members:
+                keep[f] = bi
+            total_conflicts += conflicts[bi]
+    if not keep:
+        return None
+
+    # column order: walk used features ascending; a bundle's column sits
+    # at its first member's position, members sorted ascending (the
+    # deterministic conflict-overwrite order)
+    emitted = set()
+    column_members: List[List[int]] = []
+    column_offsets: List[List[int]] = []
+    for f in range(F):
+        if f in emitted:
+            continue
+        bi = keep.get(f)
+        if bi is None:
+            column_members.append([f])
+            column_offsets.append([0])
+            emitted.add(f)
+            continue
+        members = sorted(bundles[bi])
+        offs = []
+        o = 1
+        for m in members:
+            offs.append(o)
+            o += int(mappers[m].num_bin) - 1
+        column_members.append(members)
+        column_offsets.append(offs)
+        emitted.update(members)
+    return BundlePlan(column_members, column_offsets, F,
+                      sample_conflicts=total_conflicts)

@@ -21,6 +21,7 @@ import numpy as np
 
 from ..utils import log
 from .binning import BinMapper, CATEGORICAL, NUMERICAL
+from .bundling import BundlePlan, plan_bundles
 
 _BINARY_TOKEN = b"__lightgbm_tpu_dataset_v1__"
 
@@ -141,16 +142,32 @@ def build_mappers_from_sample(sample: np.ndarray, num_data: int, *,
     return out
 
 
+def _bins_dtype(mappers, plan) -> type:
+    """uint8 unless some COLUMN needs more than 256 bin codes (a bundle's
+    total bin budget is capped at max_bin, so bundling never forces a
+    wider dtype than the widest single feature would)."""
+    per_col = [m.num_bin for m in mappers] or [1]
+    if plan is not None:
+        per_col = [1 + sum(mappers[f].num_bin - 1 for f in members)
+                   if len(members) > 1 else mappers[members[0]].num_bin
+                   for members in plan.column_members]
+    return np.uint8 if max(per_col or [1]) <= 256 else np.uint16
+
+
 class BinnedDataset:
     """Column-binned training matrix.
 
     Attributes:
-      bins: [num_used_features, num_data] uint8/uint16 feature-major bin codes.
-      mappers: per *used* feature BinMapper.
+      bins: [num_columns, num_data] uint8/uint16 column-major bin codes —
+        one column per used feature, or per EFB bundle when
+        ``bundle_plan`` is set (io/bundling.py: mutually-exclusive sparse
+        features share a column with offset-encoded bin sub-ranges).
+      mappers: per *used* feature BinMapper (always original space).
       used_feature_map: used feature -> real (original) feature index.
       real_to_inner: real feature index -> used index or -1 (trivial/ignored).
       num_total_features: F of the raw matrix.
       feature_names: real-feature names.
+      bundle_plan: Optional[BundlePlan] — None = plain per-feature layout.
       metadata: Metadata.
     """
 
@@ -161,6 +178,7 @@ class BinnedDataset:
         self.real_to_inner: np.ndarray = np.zeros(0, dtype=np.int64)
         self.num_total_features = 0
         self.feature_names: List[str] = []
+        self.bundle_plan: Optional[BundlePlan] = None
         self.metadata = Metadata()
         self.max_bin = 255
         self.label_idx = 0
@@ -177,6 +195,9 @@ class BinnedDataset:
                     data_random_seed: int = 1,
                     label_idx: int = 0,
                     predefined_mappers: Optional[List[Optional[BinMapper]]] = None,
+                    enable_bundle: bool = False,
+                    max_conflict_rate: float = 0.0,
+                    is_enable_sparse: bool = True,
                     ) -> "BinnedDataset":
         """Bin a raw [N, F] float matrix (dataset_loader.cpp:656-820 flow:
         sample rows -> per-feature FindBin -> extract features)."""
@@ -226,11 +247,25 @@ class BinnedDataset:
         if not used:
             log.warning("All features are trivial; dataset has no usable feature")
 
-        dtype = np.uint8 if max(
-            [m.num_bin for m in mappers] or [1]) <= 256 else np.uint16
-        self.bins = np.zeros((len(used), num_data), dtype=dtype)
-        for inner, f in enumerate(used):
-            self.bins[inner] = mappers[inner].value_to_bin(data[:, f]).astype(dtype)
+        # EFB (io/bundling.py): pack mutually-exclusive sparse features
+        # into shared columns before any device array is built.  The plan
+        # is drawn over the SAME sample FindBin saw, so in-memory and
+        # two-round loading agree on bundles for identical samples.
+        self.bundle_plan = plan_bundles(
+            sample, mappers, used,
+            max_conflict_rate=max_conflict_rate, max_total_bin=max_bin,
+            enable_bundle=enable_bundle, is_enable_sparse=is_enable_sparse)
+
+        dtype = _bins_dtype(mappers, self.bundle_plan)
+        feature_bins = (lambda inner:
+                        mappers[inner].value_to_bin(data[:, used[inner]]))
+        if self.bundle_plan is not None:
+            self.bins = self.bundle_plan.encode_columns(
+                feature_bins, num_data, dtype)
+        else:
+            self.bins = np.zeros((len(used), num_data), dtype=dtype)
+            for inner in range(len(used)):
+                self.bins[inner] = feature_bins(inner).astype(dtype)
 
         self.metadata = Metadata(num_data)
         if label is not None:
@@ -250,12 +285,22 @@ class BinnedDataset:
         valid.used_feature_map = list(self.used_feature_map)
         valid.real_to_inner = self.real_to_inner.copy()
         valid.mappers = self.mappers
+        valid.bundle_plan = self.bundle_plan
         num_data = data.shape[0]
-        valid.bins = np.zeros((len(self.used_feature_map), num_data),
-                              dtype=self.bins.dtype)
-        for inner, f in enumerate(self.used_feature_map):
-            valid.bins[inner] = self.mappers[inner].value_to_bin(
-                data[:, f]).astype(self.bins.dtype)
+        feature_bins = (lambda inner: self.mappers[inner].value_to_bin(
+            data[:, self.used_feature_map[inner]]))
+        if self.bundle_plan is not None:
+            # validation rows ride the TRAINING bundles: replay/scoring
+            # happens on the bundled device matrix, so both sides must
+            # share one column layout (Dataset::CheckAlign)
+            valid.bins = self.bundle_plan.encode_columns(
+                feature_bins, num_data, self.bins.dtype)
+        else:
+            valid.bins = np.zeros((len(self.used_feature_map), num_data),
+                                  dtype=self.bins.dtype)
+            for inner in range(len(self.used_feature_map)):
+                valid.bins[inner] = feature_bins(inner).astype(
+                    self.bins.dtype)
         valid.metadata = Metadata(num_data)
         if label is not None:
             valid.metadata.set_label(label)
@@ -273,6 +318,7 @@ class BinnedDataset:
         sub.used_feature_map = list(self.used_feature_map)
         sub.real_to_inner = self.real_to_inner.copy()
         sub.mappers = self.mappers
+        sub.bundle_plan = self.bundle_plan
         sub.bins = np.ascontiguousarray(self.bins[:, indices])
         sub.metadata = Metadata(len(indices))
         md, smd = self.metadata, sub.metadata
@@ -304,7 +350,14 @@ class BinnedDataset:
 
     @property
     def num_features(self) -> int:
-        """Number of *used* (non-trivial) features."""
+        """Number of *used* (non-trivial) ORIGINAL features — the split
+        finder's feature space.  Equal to ``num_columns`` unless EFB
+        bundled features into shared columns."""
+        return len(self.used_feature_map)
+
+    @property
+    def num_columns(self) -> int:
+        """Physical bin-matrix columns (== num_features when unbundled)."""
         return self.bins.shape[0]
 
     def num_bin_per_feature(self) -> np.ndarray:
@@ -335,6 +388,8 @@ class BinnedDataset:
             "num_total_features": self.num_total_features,
             "feature_names": self.feature_names,
             "max_bin": self.max_bin,
+            "bundle_plan": (self.bundle_plan.to_state()
+                            if self.bundle_plan is not None else None),
         })
         arrays: Dict[str, Any] = {
             "bins": self.bins,
@@ -375,6 +430,7 @@ class BinnedDataset:
         self.num_total_features = int(meta["num_total_features"])
         self.feature_names = list(meta["feature_names"])
         self.max_bin = int(meta["max_bin"])
+        self.bundle_plan = BundlePlan.from_state(meta.get("bundle_plan"))
         self.metadata = Metadata(self.bins.shape[1])
         if "label" in arrays:
             self.metadata.label = arrays["label"]

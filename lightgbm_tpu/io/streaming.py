@@ -46,7 +46,9 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 from ..utils import log
 from ..utils.log import LightGBMError
 from .binning import BinMapper
-from .dataset import BinnedDataset, Metadata, build_mappers_from_sample
+from .bundling import plan_bundles
+from .dataset import (BinnedDataset, Metadata, _bins_dtype,
+                      build_mappers_from_sample)
 from .guard import (IngestGuard, check_side_files_alignment, column_index,
                     feature_value)
 from .parser import (_BadLine, _parse_chunk,  # noqa: F401 (re-export)
@@ -154,7 +156,10 @@ def load_file_two_round(path: str, *, has_header: bool = False,
                         data_random_seed: int = 1,
                         reference: Optional[BinnedDataset] = None,
                         chunk_rows: int = 262144,
-                        guard: Optional[IngestGuard] = None
+                        guard: Optional[IngestGuard] = None,
+                        enable_bundle: bool = False,
+                        max_conflict_rate: float = 0.0,
+                        is_enable_sparse: bool = True,
                         ) -> BinnedDataset:
     """Stream-load ``path`` into a BinnedDataset without materializing the
     float matrix.  Identical output to parse_file + from_matrix (asserted
@@ -261,6 +266,7 @@ def load_file_two_round(path: str, *, has_header: bool = False,
         ds.used_feature_map = list(reference.used_feature_map)
         ds.real_to_inner = reference.real_to_inner.copy()
         ds.mappers = reference.mappers
+        ds.bundle_plan = reference.bundle_plan
     else:
         # trivial-feature filtering scales to the (estimated) CLEAN row
         # count: bad rows already classified never reach the bins, so
@@ -285,10 +291,19 @@ def load_file_two_round(path: str, *, has_header: bool = False,
         if not used:
             log.warning("All features are trivial; dataset has no usable "
                         "feature")
+        # EFB over the round-1b sample — the SAME sample the in-memory
+        # path would draw (identical seed + global row count), so both
+        # loaders agree on bundles for identical files
+        ds.bundle_plan = plan_bundles(
+            sample, mappers, used,
+            max_conflict_rate=max_conflict_rate, max_total_bin=max_bin,
+            enable_bundle=enable_bundle, is_enable_sparse=is_enable_sparse)
 
-    dtype = np.uint8 if max([m.num_bin for m in ds.mappers] or [1]) <= 256 \
-        else np.uint16
-    ds.bins = np.zeros((len(ds.used_feature_map), num_data), dtype=dtype)
+    dtype = _bins_dtype(ds.mappers, ds.bundle_plan)
+    num_columns = (ds.bundle_plan.num_columns
+                   if ds.bundle_plan is not None
+                   else len(ds.used_feature_map))
+    ds.bins = np.zeros((num_columns, num_data), dtype=dtype)
     labels = np.zeros(num_data, np.float32)
     F_total = ds.num_total_features
     if weight_idx >= F_total:
@@ -323,11 +338,20 @@ def load_file_two_round(path: str, *, has_header: bool = False,
         lab, feats = _parse_chunk(buf, fmt, label_idx, nf, guard=g,
                                   line_numbers=nums)
         n = feats.shape[0]
-        for inner, f in enumerate(ds.used_feature_map):
+
+        def _feature_bins(inner):
+            f = ds.used_feature_map[inner]
             col = feats[:, f] if f < feats.shape[1] else \
                 np.zeros(n, np.float64)
-            ds.bins[inner, off:off + n] = \
-                ds.mappers[inner].value_to_bin(col).astype(dtype)
+            return ds.mappers[inner].value_to_bin(col)
+
+        if ds.bundle_plan is not None:
+            ds.bins[:, off:off + n] = ds.bundle_plan.encode_columns(
+                _feature_bins, n, dtype)
+        else:
+            for inner in range(len(ds.used_feature_map)):
+                ds.bins[inner, off:off + n] = \
+                    _feature_bins(inner).astype(dtype)
         labels[off:off + n] = lab.astype(np.float32)
         if weights is not None and weight_idx < feats.shape[1]:
             weights[off:off + n] = feats[:, weight_idx]

@@ -13,7 +13,7 @@ import functools
 import io
 import os
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -22,7 +22,9 @@ import jax.numpy as jnp
 from .. import obs
 from ..config import Config
 from ..io.binning import CATEGORICAL
+from ..io.bundling import BundlePlan
 from ..io.dataset import BinnedDataset
+from ..ops.bundle import BundleDecode
 from ..metric import Metric, create_metric
 from ..objective import ObjectiveFunction, create_objective
 from ..ops.grow import (GrowParams, SerialComm, grow_tree, pack_tree_arrays,
@@ -31,7 +33,20 @@ from ..ops.ordered_grow import grow_tree_ordered, pack_u8_words
 from ..ops.predict import predict_binned_forest, predict_binned_tree
 from ..utils import compile_cache, log, timetag
 from ..utils.log import LightGBMError
+from .screening import GainScreener
 from .tree import Tree
+
+
+class _HistView(NamedTuple):
+    """One round's histogram-side data view: the (possibly EFB-bundled,
+    possibly screening-compacted) column matrix plus its decode tables.
+    Passed as a runtime pytree into the shared train_step / grow
+    programs, so switching views never rebuilds a closure — the full
+    view and the compacted view each trace once and are reused."""
+    bins: Any                  # [C, N] column bin codes
+    bins_rm: Any               # [N, C] row-major copy or None
+    bins_words: Any            # word-packed lanes (ordered grower) or None
+    bundle: Any                # ops.bundle.BundleDecode or None
 
 
 def estimate_train_memory(num_data: int, num_features: int, num_leaves: int,
@@ -188,13 +203,14 @@ class _DeviceData:
                                    (0, self.padded_rows - score.shape[-1])))
         self.score = jnp.asarray(score)
 
-    def add_tree(self, tree_arrays, is_cat, cls: int, max_steps: int):
+    def add_tree(self, tree_arrays, is_cat, cls: int, max_steps: int,
+                 bundle=None):
         n = tree_arrays.split_feature.shape[0]
         delta, _ = predict_binned_tree(
             tree_arrays.split_feature, tree_arrays.split_bin,
             is_cat[jnp.maximum(tree_arrays.split_feature, 0)],
             tree_arrays.left_child, tree_arrays.right_child,
-            tree_arrays.leaf_value, self.bins, max_steps)
+            tree_arrays.leaf_value, self.bins, max_steps, bundle=bundle)
         self.score = self.score.at[cls].add(delta)
 
 
@@ -338,7 +354,7 @@ def _build_shared_train_step(objective, num_class: int, guard: bool,
     fused_comm = SerialComm(leaf_cache=False, fused_gain=True)
 
     def step_fn(score, feat_masks, row_weight, lr, bins, num_bin, is_cat,
-                grad_arrays, bins_rm, bins_words):
+                grad_arrays, bins_rm, bins_words, bundle):
         grad, hess = objective.gradients_with(grad_arrays, score)
         ok = (_all_finite(grad, hess) if guard else jnp.asarray(True))
         outs = []
@@ -346,13 +362,17 @@ def _build_shared_train_step(objective, num_class: int, guard: bool,
             args = (bins, num_bin, is_cat, feat_masks[cls], grad[cls],
                     hess[cls], row_weight, lr)
             if kind == "ordered":
+                # the leaf-ordered grower has no column decode; kind
+                # selection guarantees bundle is None here
                 ta, _, delta = grow_tree_ordered(*args, params,
                                                  bins_rm=bins_rm,
                                                  bins_words=bins_words)
             elif kind == "fused":
-                ta, _, delta = grow_tree(*args, params, fused_comm, bins_rm)
+                ta, _, delta = grow_tree(*args, params, fused_comm, bins_rm,
+                                         bundle=bundle)
             else:
-                ta, _, delta = grow_tree(*args, params, bins_rm=bins_rm)
+                ta, _, delta = grow_tree(*args, params, bins_rm=bins_rm,
+                                         bundle=bundle)
             score = score.at[cls].add(delta)
             outs.append((pack_tree_arrays(ta), ta, delta))
         return score, outs, ok
@@ -393,6 +413,13 @@ class GBDT:
     _pending_iter = None          # [tree_arrays] of the last iteration
     _pending_shrinkage = 1.0
     _no_more_splits = False
+    # -- wide-sparse subsystem (docs/SPARSE.md; None/off on loaded
+    # prediction-only boosters) ----------------------------------------
+    _bundle = None                # ops.bundle.BundleDecode (EFB)
+    _bundle_plan = None
+    _screener = None              # models/screening.py GainScreener
+    _screen_mask_dev = None
+    _parallel_grow_active = False
     # -- telemetry (lightgbm_tpu/obs/; all optional, None/zero = off) ----
     _telemetry = None             # obs.EventRecorder (set_event_recorder)
     _trace = None                 # obs.TraceCapture window (env/config)
@@ -435,6 +462,8 @@ class GBDT:
         self.num_bin = jnp.asarray(train_set.num_bin_per_feature())
         self.is_cat = jnp.asarray(train_set.is_categorical_per_feature())
         self.max_bin = cfg.max_bin
+        self.num_columns = train_set.num_columns
+        self._setup_bundle(train_set, cfg)
         self.grow_params = self._make_grow_params(cfg)
         self.shrinkage_rate = cfg.learning_rate
 
@@ -472,13 +501,60 @@ class GBDT:
         self._init_row_state()
         self._grad_arrays = self.objective.gradient_arrays(self._padded_rows)
         self._grad_fn = self._make_grad_fn()
+        self._setup_screening(cfg)
         self._grow_fn = self._make_grow_fn()
+        self._full_view = self._make_full_view()
         # device-constant caches (avoid a host->device transfer per iter)
         self._full_feat_mask = jnp.ones(self.num_features, bool)
         self._full_feat_masks = jnp.ones((self.num_class, self.num_features),
                                          bool)
         self._lr_cache: Tuple[float, jax.Array] = (-1.0, jnp.float32(0))
         self._train_step = None
+
+    def _setup_bundle(self, train_set: BinnedDataset, cfg: Config) -> None:
+        """Device decode tables for an EFB-bundled dataset
+        (io/bundling.py plan -> ops/bundle.py BundleDecode)."""
+        plan = getattr(train_set, "bundle_plan", None)
+        self._bundle_plan = plan
+        self._bundle = None
+        if plan is None:
+            self._bundle_col_np = np.arange(self.num_features, dtype=np.int64)
+            return
+        dn = plan.decode_arrays(
+            [m.num_bin for m in train_set.mappers],
+            [m.default_bin for m in train_set.mappers], cfg.max_bin)
+        self._bundle = BundleDecode(
+            col=jnp.asarray(dn["col"]), off=jnp.asarray(dn["off"]),
+            width=jnp.asarray(dn["width"]),
+            slot_map=jnp.asarray(dn["slot_map"]),
+            default_bin=jnp.asarray(dn["default_bin"]))
+        self._bundle_col_np = dn["col"].astype(np.int64)
+        log.info("EFB active: %d feature(s) in %d column(s) "
+                 "(%d bundle(s))", self.num_features, self.num_columns,
+                 len(plan.bundles))
+
+    def _setup_screening(self, cfg: Config) -> None:
+        """EMA-FS gain screening state (models/screening.py)."""
+        ratio = float(getattr(cfg, "feature_screen_ratio", 0.0) or 0.0)
+        self._screener = None
+        self._screen_mask_dev = None
+        self._screen_mask_np = None
+        self._screen_period = -1
+        self._active_view = None
+        self._identity_decode = None
+        if ratio <= 0.0:
+            return
+        self._screener = GainScreener(
+            self.num_features, self.num_columns, self._bundle_col_np,
+            ratio=ratio,
+            refresh=int(getattr(cfg, "feature_screen_refresh", 10) or 10),
+            warmup=int(getattr(cfg, "feature_screen_warmup", 20) or 0),
+            decay=float(getattr(cfg, "feature_screen_decay", 0.9) or 0.9))
+
+    def _make_full_view(self) -> _HistView:
+        td = self.train_data
+        return _HistView(bins=td.bins, bins_rm=td.bins_rm,
+                         bins_words=td.bins_words, bundle=self._bundle)
 
     @staticmethod
     def _row_buckets_enabled(cfg: Config) -> bool:
@@ -520,6 +596,20 @@ class GBDT:
         cfg = self.config
         if cfg.serial_grow == "fused":
             return "fused"
+        # EFB columns and screening's compacted views both need the
+        # per-split column decode, which the leaf-ordered grower's packed
+        # word lanes do not carry — route to the cached learner (exact
+        # parity with ordered is pinned by tests/test_ordered_grow.py)
+        needs_decode = (self._bundle is not None
+                        or self._screener is not None)
+        if needs_decode:
+            if cfg.serial_grow == "ordered":
+                log.warn_once(
+                    "serial_grow_decode",
+                    "serial_grow=ordered: using the cached serial "
+                    "learner instead (EFB bundling / feature screening "
+                    "need the column-decode path)")
+            return "cached"
         if cfg.serial_grow == "ordered" \
                 and self.train_data.bins_words is not None:
             return "ordered"
@@ -534,7 +624,7 @@ class GBDT:
         (reference feature_histogram.hpp:299-455)."""
         est = estimate_train_memory(
             getattr(self, "_padded_rows", train_set.num_data),
-            train_set.num_features, cfg.num_leaves,
+            train_set.num_columns, cfg.num_leaves,
             cfg.max_bin, self.num_class,
             bin_itemsize=train_set.bins.dtype.itemsize)
         obs.set_gauge("hbm_train_estimate_bytes", int(est["total"]))
@@ -546,12 +636,12 @@ class GBDT:
                 "histogram_pool_size",
                 "histogram_pool_size=%.0fMB requested but the TPU design "
                 "keeps the whole per-leaf histogram cache resident "
-                "(%.0fMB for num_leaves=%d x %d features x 9 x %d bins); "
+                "(%.0fMB for num_leaves=%d x %d columns x 9 x %d bins); "
                 "the parameter is accepted for config compatibility and "
                 "does NOT bound memory — lower num_leaves/max_bin to "
                 "shrink the cache", pool_mb,
                 est["histogram_cache"] / (1 << 20), cfg.num_leaves,
-                train_set.num_features, cfg.max_bin)
+                train_set.num_columns, cfg.max_bin)
         # running account for add_valid_dataset's incremental re-check
         self._train_mem_est = int(est["total"])
         self._valid_mem_bytes = 0
@@ -626,22 +716,36 @@ class GBDT:
                 fn = make_parallel_grow(mesh, cfg.tree_learner,
                                         self.grow_params, top_k=cfg.top_k)
                 # static per-tree collective account (obs layer): computed
-                # once from shapes, accumulated per iteration
+                # once from shapes, accumulated per iteration.  Under EFB
+                # data-parallel reduces COLUMN-shaped histograms (and is
+                # forced to the full psum — mirrored by bundled=True);
+                # voting/feature ship per-ORIGINAL-feature payloads.
                 from ..parallel.comm import traffic_totals
-                self._comm_traffic = fn.traffic_per_tree(self.num_features)
+                traffic_f = (self.num_columns if cfg.tree_learner == "data"
+                             else self.num_features)
+                self._comm_traffic = fn.traffic_per_tree(
+                    traffic_f, bundled=self._bundle is not None)
                 self._comm_traffic_totals = traffic_totals(self._comm_traffic)
+                self._parallel_grow_active = True
                 if jax.process_count() > 1:
                     # multi-controller runtime: promote per-process inputs
                     # to global arrays / gather sharded outputs back
+                    # (bundling is disabled under multihost loading, so
+                    # the wrapped signature never carries a bundle)
                     from ..parallel.multihost import globalize_grow_fn
                     fn = globalize_grow_fn(fn, mesh)
-                self._parallel_grow_active = True
-                return fn
+                    return (lambda view, nb, ic, fm, g, h, w, lr:
+                            fn(view.bins, nb, ic, fm, g, h, w, lr))
+                if self._bundle is None:
+                    return (lambda view, nb, ic, fm, g, h, w, lr:
+                            fn(view.bins, nb, ic, fm, g, h, w, lr))
+                return (lambda view, nb, ic, fm, g, h, w, lr:
+                        fn(view.bins, nb, ic, fm, g, h, w, lr,
+                           bundle=view.bundle))
             log.warning("tree_learner=%s requested but only %d device(s) "
                         "available; falling back to serial",
                         cfg.tree_learner, ndev)
         params = self.grow_params
-        bins_rm = self.train_data.bins_rm
         kind = self._serial_grow_kind()
         if kind == "ordered":
             # leaf-ordered physical layout: partition cost ~ parent
@@ -649,22 +753,27 @@ class GBDT:
             # tested against the unordered cached learner).  Its i32 lane
             # packing is uint8-only; >256-bin datasets use the cached
             # learner (logged so the throughput change is visible).
-            bins_words = self.train_data.bins_words
-            return lambda *args: grow_tree_ordered(*args, params,
-                                                   bins_rm=bins_rm,
-                                                   bins_words=bins_words)
+            return (lambda view, nb, ic, fm, g, h, w, lr:
+                    grow_tree_ordered(view.bins, nb, ic, fm, g, h, w, lr,
+                                      params, bins_rm=view.bins_rm,
+                                      bins_words=view.bins_words))
         if kind == "fused":
             # full-pass growth through the fused histogram->split-gain
             # kernel (ops/pallas_histogram.py): both children's
             # per-feature BestSplit candidates come straight out of the
             # histogram pass — the [2, F, B, 3] tensor never lands in HBM
             comm = SerialComm(leaf_cache=False, fused_gain=True)
-            return lambda *args: grow_tree(*args, params, comm, bins_rm)
-        if cfg.serial_grow == "ordered":
+            return (lambda view, nb, ic, fm, g, h, w, lr:
+                    grow_tree(view.bins, nb, ic, fm, g, h, w, lr, params,
+                              comm, view.bins_rm, bundle=view.bundle))
+        if cfg.serial_grow == "ordered" and self._bundle is None \
+                and self._screener is None:
             log.info("max_bin > 256: using the cached (original-order) "
                      "serial learner; the leaf-ordered fast path is "
                      "uint8-only")
-        return lambda *args: grow_tree(*args, params, bins_rm=bins_rm)
+        return (lambda view, nb, ic, fm, g, h, w, lr:
+                grow_tree(view.bins, nb, ic, fm, g, h, w, lr, params,
+                          bins_rm=view.bins_rm, bundle=view.bundle))
 
     def reset_config(self, config: Config) -> None:
         """Booster::ResetConfig (c_api.cpp:96-134): re-derive learner
@@ -677,6 +786,18 @@ class GBDT:
         # be unpacked with them before num_leaves can change.
         self._flush_pending()
         self.shrinkage_rate = config.learning_rate
+        # feature_screen_* changes (reset_parameter callback) rebuild the
+        # screener — ONLY on a real change, so per-round learning-rate
+        # schedules don't wipe the gain EWMA every iteration
+        def _screen_key(cfg):
+            return tuple(float(getattr(cfg, k, 0) or 0) for k in
+                         ("feature_screen_ratio", "feature_screen_refresh",
+                          "feature_screen_warmup", "feature_screen_decay"))
+        if old_cfg is not None and _screen_key(old_cfg) != _screen_key(config):
+            self._setup_screening(config)
+            self._grow_fn = self._make_grow_fn()
+            self._full_view = self._make_full_view()
+            self._train_step = None
         new_params = self._make_grow_params(config)
         if new_params != self.grow_params or (
                 old_cfg is not None
@@ -710,6 +831,8 @@ class GBDT:
         self.objective.init(train_set.metadata, train_set.num_data)
         self.num_bin = jnp.asarray(train_set.num_bin_per_feature())
         self.is_cat = jnp.asarray(train_set.is_categorical_per_feature())
+        self.num_columns = train_set.num_columns
+        self._setup_bundle(train_set, cfg)
         self._padded_rows = (compile_cache.bucket_rows(self.num_data)
                              if self._row_buckets_enabled(cfg)
                              and not self.objective.uses_legacy_gradients()
@@ -727,7 +850,9 @@ class GBDT:
         # arguments now, not compile-time constants)
         self._grad_arrays = self.objective.gradient_arrays(self._padded_rows)
         self._grad_fn = self._make_grad_fn()
+        self._setup_screening(cfg)
         self._grow_fn = self._make_grow_fn()
+        self._full_view = self._make_full_view()
         self._train_step = None
         for i, tree in enumerate(self._models):
             self._add_host_tree_to(self.train_data, tree, i % self.num_class)
@@ -746,7 +871,7 @@ class GBDT:
         # check cannot see the allocation coming and training would die
         # in an XLA OOM after hours of work.
         est = estimate_valid_memory(
-            valid_set.num_data, valid_set.num_features, self.num_class,
+            valid_set.num_data, valid_set.num_columns, self.num_class,
             bin_itemsize=valid_set.bins.dtype.itemsize)
         valid_bytes = getattr(self, "_valid_mem_bytes", 0) + int(est["total"])
         total = getattr(self, "_train_mem_est", 0) + valid_bytes
@@ -806,24 +931,124 @@ class GBDT:
         return self._row_weight
 
     def _feature_mask(self) -> jax.Array:
-        """feature_fraction sampling per tree (serial_tree_learner.cpp:226+)."""
+        """feature_fraction sampling per tree (serial_tree_learner.cpp:226+)
+        intersected with this round's gain-screening mask (EMA-FS,
+        models/screening.py) when one is active."""
         frac = self.config.feature_fraction
+        screen = self._screen_mask_dev
         if frac >= 1.0:
-            return self._full_feat_mask
+            return (self._full_feat_mask if screen is None
+                    else self._full_feat_mask & screen)
         used = max(1, int(self.num_features * frac))
         idx = self._feature_rng.choice(self.num_features, used, replace=False)
         mask = np.zeros(self.num_features, bool)
         mask[idx] = True
-        return jnp.asarray(mask)
+        out = jnp.asarray(mask)
+        return out if screen is None else out & screen
 
     def _feature_masks_all(self) -> jax.Array:
         """[num_class, F] per-class feature masks for the fused step (same
         RNG draw order as per-class _feature_mask calls)."""
         frac = self.config.feature_fraction
         if frac >= 1.0:
-            return self._full_feat_masks
+            screen = self._screen_mask_dev
+            return (self._full_feat_masks if screen is None
+                    else self._full_feat_masks & screen[None, :])
         return jnp.stack([self._feature_mask()
                           for _ in range(self.num_class)])
+
+    # -- gain-informed screening views (docs/SPARSE.md) ----------------
+    def _select_view(self) -> "_HistView":
+        """Pick this round's histogram view and screening mask.
+
+        Warmup and refresh rounds run the FULL view with every feature
+        unmasked; screened rounds run the compacted active-column view
+        (when available) under the EWMA-derived mask.  Both views and
+        the masks are runtime arguments to the shared programs, so
+        toggling costs zero recompiles after each view's first trace
+        (ledger-pinned in tests/test_screening.py)."""
+        scr = self._screener
+        if scr is None:
+            return self._full_view
+        it = self.iter_ - self.num_init_iteration
+        mode = scr.round_mode(it)
+        if mode != "screened":
+            self._screen_mask_dev = None
+            self._screen_mask_np = None
+            obs.set_gauge("screen_active_features", self.num_features)
+            if mode == "refresh":
+                obs.inc("screen_refresh_total")
+                scr.refresh_total += 1
+            return self._full_view
+        period = scr.period(it)
+        if period != self._screen_period:
+            self._screen_period = period
+            cols = scr.active_columns()
+            self._screen_mask_np = scr.screen_mask(cols)
+            self._screen_mask_dev = jnp.asarray(self._screen_mask_np)
+            self._active_view = self._build_active_view(cols)
+        obs.set_gauge("screen_active_features",
+                      int(self._screen_mask_np.sum()))
+        return (self._active_view if self._active_view is not None
+                else self._full_view)
+
+    def _screen_decode_base(self) -> BundleDecode:
+        """Decode tables the compacted view derives from: the EFB tables
+        when the dataset is bundled, else identity tables (a trivial
+        all-singleton plan)."""
+        if self._bundle is not None:
+            return self._bundle
+        if self._identity_decode is None:
+            plan = BundlePlan([[f] for f in range(self.num_features)],
+                              [[0]] * self.num_features, self.num_features)
+            dn = plan.decode_arrays(
+                [m.num_bin for m in self.train_set.mappers],
+                [m.default_bin for m in self.train_set.mappers],
+                self.config.max_bin)
+            self._identity_decode = BundleDecode(
+                col=jnp.asarray(dn["col"]), off=jnp.asarray(dn["off"]),
+                width=jnp.asarray(dn["width"]),
+                slot_map=jnp.asarray(dn["slot_map"]),
+                default_bin=jnp.asarray(dn["default_bin"]))
+        return self._identity_decode
+
+    def _build_active_view(self, cols: np.ndarray) -> Optional["_HistView"]:
+        """Gather the active columns into a fixed-budget [C_pad, N]
+        block (one device gather per refresh period).  C_pad is the
+        compile-cache bucket of the CONSTANT keep_cols budget, so every
+        screened round of the run shares one compiled program.  Returns
+        None (mask-only screening) under the distributed learners or
+        when compaction would not shrink the pass."""
+        if self._parallel_grow_active:
+            return None
+        try:
+            if jax.process_count() > 1:
+                return None
+        except Exception:  # pragma: no cover - uninitialized backend
+            pass
+        c_pad = compile_cache.bucket_rows(len(cols))
+        if c_pad >= self.num_columns:
+            return None
+        idx = np.full(c_pad, 1 << 30, np.int64)
+        idx[:len(cols)] = cols
+        idx_dev = jnp.asarray(idx)
+        td = self.train_data
+        bins_act = jnp.take(td.bins, idx_dev, axis=0,
+                            mode="fill", fill_value=0)
+        bins_rm_act = (jnp.take(td.bins_rm, idx_dev, axis=1,
+                                mode="fill", fill_value=0)
+                       if td.bins_rm is not None else None)
+        base = self._screen_decode_base()
+        pos = np.zeros(self.num_features, np.int32)
+        pos_of = {int(c): i for i, c in enumerate(cols)}
+        for f in range(self.num_features):
+            # dropped features point at column 0; they are masked out of
+            # the scan, so the junk expansion is never consulted
+            pos[f] = pos_of.get(int(self._bundle_col_np[f]), 0)
+        bundle_act = base._replace(col=jnp.asarray(pos))
+        obs.inc("screen_compactions_total")
+        return _HistView(bins=bins_act, bins_rm=bins_rm_act,
+                         bins_words=None, bundle=bundle_act)
 
     # ------------------------------------------------------------------
     def _gradients(self) -> Tuple[jax.Array, jax.Array]:
@@ -860,14 +1085,13 @@ class GBDT:
         jit = _shared_train_step(self.objective, self.num_class, guard,
                                  self._serial_grow_kind(), self.grow_params,
                                  donate=not guard and _donation_enabled())
-        td = self.train_data
-        bins, bins_rm, bins_words = td.bins, td.bins_rm, td.bins_words
         num_bin, is_cat = self.num_bin, self.is_cat
         grad_arrays = self._grad_arrays
 
-        def step(score, feat_masks, row_weight, lr):
-            return jit(score, feat_masks, row_weight, lr, bins, num_bin,
-                       is_cat, grad_arrays, bins_rm, bins_words)
+        def step(score, feat_masks, row_weight, lr, view):
+            return jit(score, feat_masks, row_weight, lr, view.bins,
+                       num_bin, is_cat, grad_arrays, view.bins_rm,
+                       view.bins_words, view.bundle)
         return step
 
     def _make_train_step_local(self, guard: bool):
@@ -876,17 +1100,16 @@ class GBDT:
         registry cannot key portably."""
         grow = self._grow_fn
         obj_grad = self._grad_fn
-        bins, num_bin, is_cat = (self.train_data.bins, self.num_bin,
-                                 self.is_cat)
+        num_bin, is_cat = self.num_bin, self.is_cat
         num_class = self.num_class
 
         @obs.instrumented_jit(program="train_step")
-        def step_fn(score, feat_masks, row_weight, lr):
+        def step_fn(score, feat_masks, row_weight, lr, view):
             grad, hess = obj_grad(score)
             ok = (_all_finite(grad, hess) if guard else jnp.asarray(True))
             outs = []
             for cls in range(num_class):
-                ta, _, delta = grow(bins, num_bin, is_cat, feat_masks[cls],
+                ta, _, delta = grow(view, num_bin, is_cat, feat_masks[cls],
                                     grad[cls], hess[cls], row_weight, lr)
                 score = score.at[cls].add(delta)
                 outs.append((pack_tree_arrays(ta), ta, delta))
@@ -930,6 +1153,11 @@ class GBDT:
                                   self.train_set.used_feature_map,
                                   self._pending_shrinkage)
                  for iv, fv in host]
+        if self._screener is not None:
+            # realized split gains feed the EMA-FS feature EWMA
+            # (models/screening.py); 1-leaf saturated trees contribute
+            # nothing, so observing before the saturation check is safe
+            self._screener.observe_trees(trees)
         rec = self._telemetry
         shapes = ([{"num_leaves": int(t.num_leaves),
                     "max_depth": int(t.max_depth())} for t in trees]
@@ -1201,6 +1429,9 @@ class GBDT:
         if guard:
             score0 = self.train_data.score
             vscores0 = [dd.score for dd in self.valid_data]
+        # gain screening (models/screening.py): pick this round's
+        # histogram view + feature mask BEFORE any mask draw reads it
+        view = self._select_view()
         cur = []
         if fused:
             # standard objective: ONE device dispatch for the whole round
@@ -1211,7 +1442,8 @@ class GBDT:
             feat_masks = self._feature_masks_all()
             with timetag.scope("GBDT::tree") as tt:
                 self.train_data.score, outs, gh_ok = self._train_step(
-                    self.train_data.score, feat_masks, row_weight, lr_dev)
+                    self.train_data.score, feat_masks, row_weight, lr_dev,
+                    view)
                 tt.sync(self.train_data.score)
             if guard:
                 ok_gh, ok_sc = jax.device_get(
@@ -1266,7 +1498,7 @@ class GBDT:
                 feat_mask = self._feature_mask()
                 with timetag.scope("GBDT::tree") as tt:
                     tree_arrays, leaf_id, delta = self._grow_fn(
-                        self.train_data.bins, self.num_bin, self.is_cat,
+                        view, self.num_bin, self.is_cat,
                         feat_mask, grad[cls], hess[cls], row_weight, lr_dev)
                     tt.sync(delta)
                 with timetag.scope("GBDT::train_score") as tt:
@@ -1441,6 +1673,10 @@ class GBDT:
             "cum_comm": (int(self._cum_comm_calls),
                          int(self._cum_comm_bytes)),
             "nan_skips": int(self._nan_skips),
+            # EMA-FS screener EWMA (models/screening.py): without it a
+            # resumed run would re-warm the gain estimates from zero
+            "screen_state": (self._screener.state()
+                             if self._screener is not None else None),
         }
 
     def restore_state(self, state: Dict) -> None:
@@ -1497,6 +1733,12 @@ class GBDT:
         self._cum_comm_calls, self._cum_comm_bytes = \
             (int(v) for v in state["cum_comm"])
         self._nan_skips = int(state.get("nan_skips", 0))
+        if self._screener is not None:
+            self._screener.restore(state.get("screen_state"))
+            # force the active view/mask to rebuild from restored EWMA
+            self._screen_period = -1
+            self._screen_mask_dev = None
+            self._active_view = None
 
     # ------------------------------------------------------------------
     def _device_tree_delta(self, dd: _DeviceData, tree_arrays) -> jax.Array:
@@ -1505,7 +1747,7 @@ class GBDT:
             self.is_cat[jnp.maximum(tree_arrays.split_feature, 0)],
             tree_arrays.left_child, tree_arrays.right_child,
             tree_arrays.leaf_value, dd.bins,
-            self.grow_params.num_leaves)
+            self.grow_params.num_leaves, bundle=self._bundle)
         return delta
 
     def _add_host_tree_to(self, dd: _DeviceData, tree: Tree, cls: int):
@@ -1525,7 +1767,7 @@ class GBDT:
             jnp.asarray(tree.decision_type == 1),
             jnp.asarray(tree.left_child), jnp.asarray(tree.right_child),
             jnp.asarray(tree.leaf_value, jnp.float32), dd.bins,
-            int(tree.num_leaves))
+            int(tree.num_leaves), bundle=self._bundle)
         dd.score = dd.score.at[cls].add(delta)
 
     # ------------------------------------------------------------------
@@ -1872,8 +2114,15 @@ def _counting_forest_jit():
 
 def _mappers_aligned(a: BinnedDataset, b: BinnedDataset) -> bool:
     """True when two datasets share identical bin mappers (feature map,
-    bin counts, and boundaries) — Dataset::CheckAlign equivalent."""
+    bin counts, and boundaries) — Dataset::CheckAlign equivalent.  With
+    EFB the bundle plans must match too: replay/scoring runs on the
+    bundled column matrix, so both sides need one column layout."""
     if a.used_feature_map != b.used_feature_map:
+        return False
+    pa, pb = getattr(a, "bundle_plan", None), getattr(b, "bundle_plan", None)
+    if (pa is None) != (pb is None):
+        return False
+    if pa is not None and pa is not pb and pa.signature() != pb.signature():
         return False
     for ma, mb in zip(a.mappers, b.mappers):
         if ma is mb:

@@ -1,0 +1,117 @@
+"""EMA-FS gain-informed feature screening (docs/SPARSE.md).
+
+"EMA-FS: Accelerating GBDT Training via Gain-Informed Feature Screening"
+(PAPERS.md): most features stop earning splits after the early rounds,
+yet every round still pays their full histogram pass.  The screener
+keeps a per-feature exponentially-weighted moving average of *realized*
+split gains and masks the bottom ``feature_screen_ratio`` of the feature
+space out of each round's ``feat_masks`` — which are already runtime
+arguments to the shared ``train_step`` program (models/gbdt.py), so
+toggling masks never triggers an XLA recompile (ledger-pinned in
+tests/test_screening.py).
+
+Schedule:
+  * ``feature_screen_warmup`` unscreened rounds seed the EWMA,
+  * then every ``feature_screen_refresh``-th round is a full-feature
+    REFRESH round (all features scan, so a dormant feature whose signal
+    appears late can re-enter),
+  * all other rounds are SCREENED.
+
+Masking alone only saves split-finder work; the histogram pass still
+reads every column.  ``GBDT`` therefore also *compacts* screened rounds:
+the active COLUMNS (screening is column-granular so it composes with EFB
+bundles — a column stays active while any member feature does) are
+gathered into a fixed-budget ``[C_active_padded, N]`` block whose padded
+shape is chosen ONCE (compile-cache bucket ladder), so every screened
+round of a run shares one compiled program regardless of which columns
+are active.  The active set is re-drawn once per refresh period; the
+EWMA itself updates every round.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class GainScreener:
+    """Per-feature split-gain EWMA + the screening schedule."""
+
+    def __init__(self, num_features: int, num_columns: int,
+                 feature_col: np.ndarray, *, ratio: float, refresh: int,
+                 warmup: int, decay: float):
+        self.num_features = int(num_features)
+        self.num_columns = int(num_columns)
+        self.feature_col = np.asarray(feature_col, np.int64)
+        self.ratio = float(ratio)
+        self.refresh = max(int(refresh), 1)
+        self.warmup = max(int(warmup), 0)
+        self.decay = float(decay)
+        self.keep_cols = max(
+            1, int(math.ceil((1.0 - self.ratio) * self.num_columns)))
+        self.ewma = np.zeros(self.num_features, np.float64)
+        self._round_gain = np.zeros(self.num_features, np.float64)
+        self.refresh_total = 0
+
+    # -- gain observation ------------------------------------------------
+    def observe_trees(self, trees) -> None:
+        """Fold one iteration's materialized trees into the EWMA.
+
+        Split features arrive in inner (used-original) space
+        (Tree.split_feature_inner, models/tree.py from_arrays)."""
+        acc = self._round_gain
+        for t in trees:
+            n = int(t.num_leaves) - 1
+            if n <= 0:
+                continue
+            feats = np.asarray(t.split_feature_inner[:n], np.int64)
+            gains = np.maximum(np.asarray(t.split_gain[:n], np.float64), 0.0)
+            ok = (feats >= 0) & (feats < self.num_features)
+            np.add.at(acc, feats[ok], gains[ok])
+        self.ewma = self.decay * self.ewma + (1.0 - self.decay) * acc
+        acc[:] = 0.0
+
+    # -- schedule --------------------------------------------------------
+    def round_mode(self, it: int) -> str:
+        """'warmup' | 'refresh' | 'screened' for 0-based round ``it``."""
+        if it < self.warmup:
+            return "warmup"
+        if (it - self.warmup) % self.refresh == 0:
+            return "refresh"
+        return "screened"
+
+    def period(self, it: int) -> int:
+        """Refresh-period index; the active set is redrawn when this
+        changes (once per ``feature_screen_refresh`` rounds)."""
+        return max(it - self.warmup, 0) // self.refresh
+
+    # -- active set ------------------------------------------------------
+    def active_columns(self) -> np.ndarray:
+        """Top ``keep_cols`` columns by max member-feature EWMA (sorted
+        ascending; ties prefer the lower column index, deterministic)."""
+        score = np.full(self.num_columns, -np.inf)
+        np.maximum.at(score, self.feature_col, self.ewma)
+        # stable argsort on (-score, col): best columns first
+        order = np.lexsort((np.arange(self.num_columns), -score))
+        return np.sort(order[:self.keep_cols]).astype(np.int64)
+
+    def screen_mask(self, active_cols: np.ndarray) -> np.ndarray:
+        """[F] bool: feature's column is in the active set."""
+        keep = np.zeros(self.num_columns, bool)
+        keep[np.asarray(active_cols, np.int64)] = True
+        return keep[self.feature_col]
+
+    # -- snapshot/resume (lightgbm_tpu/snapshot.py) ----------------------
+    def state(self) -> Dict:
+        return {"ewma": self.ewma.copy(),
+                "refresh_total": int(self.refresh_total)}
+
+    def restore(self, state: Optional[Dict]) -> None:
+        if not state:
+            return
+        saved = np.asarray(state.get("ewma", ()), np.float64)
+        if saved.shape == self.ewma.shape:
+            self.ewma = saved.copy()
+        self.refresh_total = int(state.get("refresh_total", 0))

@@ -22,6 +22,8 @@ file path without importing the package (and its jax dependency).
 """
 
 HOST_PHASES = frozenset({
+    "Bin::bundle",        # EFB bundle planning over the mapper sample
+                          # (io/bundling.py, docs/SPARSE.md)
     "GBDT::iteration",    # whole boosting round (obs.span, always on)
     "GBDT::boosting",
     "GBDT::bagging",

@@ -1,0 +1,148 @@
+"""Device-side half of exclusive feature bundling (EFB).
+
+The host planner (``io/bundling.py``) packs mutually-exclusive sparse
+features into shared *columns* with offset-encoded bin sub-ranges, so the
+device bin matrix — and every histogram pass over it — shrinks from
+``[F, N]`` to ``[C, N]`` with ``C`` = bundled column count.  Split
+finding, however, must stay in ORIGINAL feature space: a contiguous
+``bin <= t`` range of a bundled column is *not* an original-feature
+partition (rows of members after the split member would route by bundle
+position, not by their own value).  The reference resolves this the same
+way (FeatureGroup histograms + per-feature OffsetBin slices +
+FixHistogram for the default bin): build histograms per column, then
+*expand* them back to per-original-feature histograms before the scan.
+
+This module owns that expansion plus the per-split bin decode:
+
+- :class:`BundleDecode` — per-original-feature gather tables, passed as
+  runtime device arrays (pytree) so toggling datasets never retraces.
+- :func:`expand_digit_sums` — int32 digit-sum expansion for the cached
+  serial learner (ops/leafhist.py).  Pure integer gathers + an exact
+  integer reconstruction of each feature's default bin
+  (``total - sum(non-default)``), so a zero-conflict bundled run is
+  BIT-IDENTICAL to the unbundled run (pinned in tests/test_bundling.py).
+- :func:`expand_histogram` — the f32 equivalent for the full-pass /
+  distributed strategies (deterministic; the default-bin reconstruction
+  re-associates one f32 sum, the same last-bit wiggle any accumulation
+  order change causes).
+- :func:`decode_feature_bins` — raw column bin -> original feature bin,
+  used by the growers' partition step and the binned tree walk.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class BundleDecode(NamedTuple):
+    """Per-original-used-feature decode tables (runtime device arrays).
+
+    col:         [F] i32  column holding feature f.
+    off:         [F] i32  column slot of f's local bin 1 (0 = feature is
+                          stored identity-encoded: its column IS its own
+                          original bin codes).
+    width:       [F] i32  non-default slot count (num_bin_f - 1) for
+                          offset-encoded features; ignored when off == 0.
+    slot_map:    [F, B] i32  histogram gather map: column bin-slot for
+                          (feature, original bin).  The feature's default
+                          bin and any bin >= num_bin_f point at the
+                          ZERO slot (index B) of the slot-padded column.
+    default_bin: [F] i32  original bin reconstructed as
+                          total - sum(non-default).
+    """
+    col: jax.Array
+    off: jax.Array
+    width: jax.Array
+    slot_map: jax.Array
+    default_bin: jax.Array
+
+
+def _slot_indices(dec: BundleDecode, lead_shape, tail: int):
+    """slot_map broadcast to ``lead_shape + (B, tail)`` for
+    take_along_axis over a slot-padded bin axis."""
+    F, B = dec.slot_map.shape
+    idx = dec.slot_map.reshape((1,) * (len(lead_shape) - 1) + (F, B, 1))
+    return jnp.broadcast_to(idx, tuple(lead_shape) + (B, tail))
+
+
+def _default_mask(dec: BundleDecode):
+    """[F, B] bool: True at each feature's default bin."""
+    F, B = dec.slot_map.shape
+    bins = jax.lax.broadcasted_iota(jnp.int32, (F, B), 1)
+    return bins == dec.default_bin[:, None]
+
+
+def expand_histogram(hist, dec: BundleDecode):
+    """[..., C, B, 3] f32 column histograms -> [..., F, B, 3] per-original-
+    feature histograms.
+
+    ``hist`` may carry one extra trailing column (the all-zero pad the
+    feature-parallel learner appends for non-owned features); ``dec.col``
+    indexes whatever column count arrives."""
+    F, B = dec.slot_map.shape
+    h = jnp.take(hist, dec.col, axis=-3)              # [..., F, B, 3]
+    tot = jnp.sum(h, axis=-2)                         # [..., F, 3]
+    zero = jnp.zeros(h.shape[:-2] + (1, h.shape[-1]), h.dtype)
+    hp = jnp.concatenate([h, zero], axis=-2)          # [..., F, B+1, 3]
+    idx = _slot_indices(dec, h.shape[:-2], h.shape[-1])
+    e = jnp.take_along_axis(hp, idx, axis=-2)         # [..., F, B, 3]
+    # default bin = column total minus the feature's non-default slots
+    # (FixHistogram, dataset.cpp:451-471) — the default slot gathered 0
+    # above, so the subtraction is not double-counted.
+    body = jnp.sum(e, axis=-2)                        # [..., F, 3]
+    recon = tot - body
+    mask = _default_mask(dec)                         # [F, B]
+    mask = mask.reshape((1,) * (e.ndim - 3) + mask.shape + (1,))
+    return jnp.where(mask, recon[..., None, :], e)
+
+
+def expand_digit_sums(sums, dec: BundleDecode):
+    """[..., C, 9, B] int32 digit sums -> [..., F, 9, B].
+
+    All-integer gathers and subtraction: the expansion is EXACT, so the
+    cached serial learner's splits over a zero-conflict bundled dataset
+    bit-match the unbundled run."""
+    F, B = dec.slot_map.shape
+    s = jnp.take(sums, dec.col, axis=-3)              # [..., F, 9, B]
+    tot = jnp.sum(s, axis=-1)                         # [..., F, 9]
+    zero = jnp.zeros(s.shape[:-1] + (1,), s.dtype)
+    sp = jnp.concatenate([s, zero], axis=-1)          # [..., F, 9, B+1]
+    idx = dec.slot_map.reshape(
+        (1,) * (s.ndim - 3) + (F, 1, B))
+    idx = jnp.broadcast_to(idx, s.shape[:-2] + (s.shape[-2], B))
+    e = jnp.take_along_axis(sp, idx, axis=-1)         # [..., F, 9, B]
+    body = jnp.sum(e, axis=-1)                        # [..., F, 9]
+    recon = tot - body                                # exact int32
+    mask = _default_mask(dec)                         # [F, B]
+    mask = mask.reshape((1,) * (e.ndim - 3) + (F, 1, B))
+    return jnp.where(mask, recon[..., None], e)
+
+
+def decode_feature_bins(bins, feat, dec: BundleDecode):
+    """Original-feature bin codes of (rows x) ``feat`` from the bundled
+    column matrix.
+
+    Args:
+      bins: [C, N] column bin codes.
+      feat: scalar i32 (grower partition) or [N] i32 (tree walk) original
+        feature index; negative values are clamped to 0 (callers mask).
+      dec: decode tables.
+    Returns [N] i32 original-feature bin codes.
+    """
+    feat = jnp.maximum(feat, 0)
+    col = dec.col[feat]
+    if col.ndim == 0:
+        raw = jnp.take(bins, col, axis=0).astype(jnp.int32)
+    else:
+        raw = jnp.take_along_axis(bins, col[None, :],
+                                  axis=0)[0].astype(jnp.int32)
+    o = dec.off[feat]
+    w = dec.width[feat]
+    in_range = (raw >= o) & (raw < o + w)
+    decoded = jnp.where(in_range, raw - o + 1, 0)
+    # off == 0 marks identity-encoded features (their column stores the
+    # original bin codes directly)
+    return jnp.where(o > 0, decoded, raw)

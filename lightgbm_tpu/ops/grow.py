@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from ..obs.compile_ledger import instrumented_jit
 
+from .bundle import decode_feature_bins, expand_digit_sums, expand_histogram
 from .histogram import (children_histograms, children_split_candidates,
                         root_histogram)
 from .split import (BestSplit, SplitParams, combine_feature_candidates,
@@ -114,7 +115,7 @@ class SerialComm(NamedTuple):
 
     def root_split(self, prep, bins, g, h, w, root_g, root_h, root_c,
                    num_bin, is_cat, feat_mask, max_bin: int,
-                   sp: SplitParams, num_leaves: int):
+                   sp: SplitParams, num_leaves: int, bundle=None):
         if not self.leaf_cache:
             if self.fused_gain:
                 # all rows in the "left" child; the right child's totals
@@ -124,19 +125,29 @@ class SerialComm(NamedTuple):
                     jnp.zeros(3, jnp.float32)])
                 cand = children_split_candidates(
                     bins, g, h, w, jnp.zeros(bins.shape[1], jnp.int32),
-                    0, -2, totals, num_bin, is_cat, feat_mask, max_bin, sp)
+                    0, -2, totals, num_bin, is_cat, feat_mask, max_bin, sp,
+                    bundle=bundle)
                 split = combine_feature_candidates(
                     jax.tree.map(lambda a: a[0], cand), root_g, root_h,
                     jnp.asarray(True), sp)
                 return split, ()
             hist = root_histogram(bins, g, h, w, max_bin)
+            if bundle is not None:
+                hist = expand_histogram(hist, bundle)
             split = find_best_split(hist, root_g, root_h, root_c, num_bin,
                                     is_cat, feat_mask, jnp.asarray(True), sp)
             return split, ()
         from . import leafhist
         F = bins.shape[0]
         sums = leafhist.digit_histogram(prep.bins_rm, prep.digits, max_bin)
-        hist = leafhist.combine_digit_sums(sums, prep.scales)  # [F, B, 3]
+        # EFB: digit sums are built (and cached) in COLUMN space — the
+        # shrunk shape is where the histogram savings live — and expanded
+        # to original feature space only for the scan.  The expansion is
+        # all-integer, so a zero-conflict bundled run bit-matches the
+        # unbundled one (tests/test_bundling.py).
+        scan_sums = (expand_digit_sums(sums, bundle)
+                     if bundle is not None else sums)
+        hist = leafhist.combine_digit_sums(scan_sums, prep.scales)
         split = find_best_split(hist, root_g, root_h, root_c, num_bin,
                                 is_cat, feat_mask, jnp.asarray(True), sp)
         cache = jnp.zeros((num_leaves, F, 9, max_bin), jnp.int32)
@@ -146,20 +157,22 @@ class SerialComm(NamedTuple):
     def children_splits(self, prep, cache, bins, g, h, w, step: _StepInfo,
                         totals_g, totals_h, totals_c, can,
                         num_bin, is_cat, feat_mask, max_bin: int,
-                        sp: SplitParams):
+                        sp: SplitParams, bundle=None):
         if not self.leaf_cache:
             if self.fused_gain:
                 totals = jnp.stack([totals_g, totals_h, totals_c], axis=-1)
                 cand = children_split_candidates(
                     bins, g, h, w, step.leaf_id, step.parent_leaf,
                     step.right_leaf, totals, num_bin, is_cat, feat_mask,
-                    max_bin, sp)
+                    max_bin, sp, bundle=bundle)
                 split = combine_feature_candidates(cand, totals_g, totals_h,
                                                    can, sp)
                 return split, cache
             hists = children_histograms(bins, g, h, w, step.leaf_id,
                                         step.parent_leaf, step.right_leaf,
                                         max_bin)
+            if bundle is not None:
+                hists = expand_histogram(hists, bundle)
             split = find_best_split(hists, totals_g, totals_h, totals_c,
                                     num_bin, is_cat, feat_mask, can, sp)
             return split, cache
@@ -198,8 +211,10 @@ class SerialComm(NamedTuple):
                 mode="drop")
 
         with jax.named_scope("find_split"):
-            hists = leafhist.combine_digit_sums(
-                jnp.stack([sums_left, sums_right]), prep.scales)
+            scan_sums = jnp.stack([sums_left, sums_right])
+            if bundle is not None:
+                scan_sums = expand_digit_sums(scan_sums, bundle)
+            hists = leafhist.combine_digit_sums(scan_sums, prep.scales)
             split = find_best_split(hists, totals_g, totals_h, totals_c,
                                     num_bin, is_cat, feat_mask, can, sp)
         return split, cache
@@ -321,32 +336,40 @@ def _store_leaf_split(state: _GrowState, leaf, split: BestSplit) -> _GrowState:
 
 @instrumented_jit(program="grow_tree", static_argnames=("params", "comm"))
 def grow_tree(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
-              learning_rate, params: GrowParams, comm=None, bins_rm=None):
+              learning_rate, params: GrowParams, comm=None, bins_rm=None,
+              bundle=None):
     """Grow one tree.  All inputs are device arrays.
 
     Args:
-      bins: [F, N] feature-major bin codes (F and N are the *local* shard
-        shapes when called under shard_map with a distributed comm).
-      num_bin: [F] i32; is_cat: [F] bool; feat_mask: [F] bool.
+      bins: [C, N] column-major bin codes (C == F unless ``bundle``; F
+        and N are the *local* shard shapes when called under shard_map
+        with a distributed comm).
+      num_bin: [F] i32; is_cat: [F] bool; feat_mask: [F] bool — always
+        ORIGINAL feature space.
       grad, hess: [N] f32 raw gradients/hessians.
       row_weight: [N] f32 bagging/GOSS weight (0 excludes a row from
         training; weights also scale grad/hess like the reference's
         gradient amplification).
       comm: static communication strategy (SerialComm by default; see
         lightgbm_tpu/parallel/comm.py for the distributed learners).
-      bins_rm: optional [N, F] row-major copy of bins for the cached serial
+      bins_rm: optional [N, C] row-major copy of bins for the cached serial
         learner's gathers (derived by transposition when omitted).
+      bundle: optional ops.bundle.BundleDecode — EFB column layout of
+        ``bins``; histograms expand back to feature space for the scan
+        and the partition decodes column bins per split.
     Returns (TreeArrays, leaf_id [N] i32, output_delta [N] f32) where
       output_delta = shrunk leaf value per row (the train-score update,
       serial_tree_learner AddPredictionToScore semantics).
     """
     return _grow_tree_impl(bins, num_bin, is_cat, feat_mask, grad, hess,
                            row_weight, learning_rate, params,
-                           SerialComm() if comm is None else comm, bins_rm)
+                           SerialComm() if comm is None else comm, bins_rm,
+                           bundle)
 
 
 def _grow_tree_impl(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
-                    learning_rate, params: GrowParams, comm, bins_rm=None):
+                    learning_rate, params: GrowParams, comm, bins_rm=None,
+                    bundle=None):
     """Unjitted growth loop — callable inside shard_map."""
     L = params.num_leaves
     B = params.max_bin
@@ -363,7 +386,7 @@ def _grow_tree_impl(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
     root_split, cache0 = comm.root_split(prep, bins, g, h, row_weight,
                                          root_g, root_h, root_c,
                                          num_bin, is_cat, feat_mask, B, sp,
-                                         L)
+                                         L, bundle=bundle)
 
     neg_inf = jnp.full((L,), K_MIN_SCORE, dtype=jnp.float32)
     state = _GrowState(
@@ -407,8 +430,14 @@ def _grow_tree_impl(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
         # --- partition: rows of best_leaf with bin > t (numerical) or
         # bin != t (categorical) move to the right child -------------------
         with jax.named_scope("split"):
-            fbin = jnp.take(bins, jnp.maximum(feat, 0),
-                            axis=0).astype(jnp.int32)
+            if bundle is None:
+                fbin = jnp.take(bins, jnp.maximum(feat, 0),
+                                axis=0).astype(jnp.int32)
+            else:
+                # EFB: the split feature lives in a shared column —
+                # decode that column's bins back to the feature's own
+                # bin space before the threshold compare
+                fbin = decode_feature_bins(bins, feat, bundle)
             go_right = jnp.where(is_cat[jnp.maximum(feat, 0)],
                                  fbin != tbin, fbin > tbin)
             in_leaf = state.leaf_id == best_leaf
@@ -489,7 +518,7 @@ def _grow_tree_impl(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
         child_split, cache = comm.children_splits(
             prep, cache, bins, g, h, row_weight, info,
             totals_g, totals_h, totals_c, can, num_bin, is_cat, feat_mask,
-            B, sp)
+            B, sp, bundle=bundle)
 
         # Invalidate the split leaf's old record, then store children.
         new_state = new_state._replace(

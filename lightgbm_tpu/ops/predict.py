@@ -19,15 +19,19 @@ from ..obs.compile_ledger import instrumented_jit
 
 
 def predict_binned_tree(split_feature, split_bin, is_cat_node, left_child,
-                        right_child, leaf_value, bins, max_steps: int):
+                        right_child, leaf_value, bins, max_steps: int,
+                        bundle=None):
     """Predict one tree on binned rows.
 
     Args:
       split_feature: [L-1] i32; split_bin: [L-1] i32; is_cat_node: [L-1] bool.
       left_child/right_child: [L-1] i32 (~leaf or node index).
       leaf_value: [L] f32.
-      bins: [F, N] bin codes.
+      bins: [F, N] bin codes ([C, N] EFB column codes when ``bundle`` is
+        given — split features/thresholds stay in original feature space
+        and each step decodes the split feature's column on the fly).
       max_steps: static depth bound (num_leaves is always enough).
+      bundle: optional ops.bundle.BundleDecode for EFB-bundled ``bins``.
     Returns ([N] f32 leaf values, [N] i32 leaf indices).
     """
     N = bins.shape[1]
@@ -38,7 +42,10 @@ def predict_binned_tree(split_feature, split_bin, is_cat_node, left_child,
         live = node >= 0
         idx = jnp.maximum(node, 0)
         feat = split_feature[idx]
-        if F <= 64:
+        if bundle is not None:
+            from .bundle import decode_feature_bins
+            fbin = decode_feature_bins(bins, feat, bundle)
+        elif F <= 64:
             # per-row feature pick as a select chain: XLA TPU lowers the
             # take_along_axis gather per index (~14 ns/row/level, measured
             # tools/probe_primitives.py) — F sequential [N] selects are

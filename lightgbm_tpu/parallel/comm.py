@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.bundle import expand_histogram
 from ..ops.histogram import children_histograms, root_histogram
 from ..ops.split import (BestSplit, SplitParams, combine_gathered_splits,
                          find_best_split, leaf_split_gain, per_feature_scan)
@@ -215,7 +216,17 @@ class DataParallelComm(NamedTuple):
                         _SPLITINFO_FIELDS * 4 * (1 + 2 * steps)))
 
     def _split_from_hist(self, hist, totals_g, totals_h, totals_c, can,
-                         num_bin, is_cat, feat_mask, sp):
+                         num_bin, is_cat, feat_mask, sp, bundle=None):
+        if bundle is not None:
+            # EFB: allreduce the (already much smaller) COLUMN histogram
+            # — a column-block reduce_scatter cannot be expanded per
+            # shard without re-gathering other shards' columns — then
+            # expand to feature space and find splits replicated.  The
+            # wire payload is [C, B], the bundling win itself.
+            hist = lax.psum(hist, self.axis_name)
+            hist = expand_histogram(hist, bundle)
+            return find_best_split(hist, totals_g, totals_h, totals_c,
+                                   num_bin, is_cat, feat_mask, can, sp)
         if self.hist_reduce == "psum":
             hist = lax.psum(hist, self.axis_name)
             return find_best_split(hist, totals_g, totals_h, totals_c,
@@ -243,22 +254,22 @@ class DataParallelComm(NamedTuple):
 
     def root_split(self, prep, bins, g, h, w, root_g, root_h, root_c,
                    num_bin, is_cat, feat_mask, max_bin: int, sp: SplitParams,
-                   num_leaves: int):
+                   num_leaves: int, bundle=None):
         hist = root_histogram(bins, g, h, w, max_bin)
         return self._split_from_hist(hist, root_g, root_h, root_c,
                                      jnp.asarray(True), num_bin, is_cat,
-                                     feat_mask, sp), ()
+                                     feat_mask, sp, bundle=bundle), ()
 
     def children_splits(self, prep, cache, bins, g, h, w, step,
                         totals_g, totals_h, totals_c, can,
                         num_bin, is_cat, feat_mask, max_bin: int,
-                        sp: SplitParams):
+                        sp: SplitParams, bundle=None):
         hists = children_histograms(bins, g, h, w, step.leaf_id,
                                     step.parent_leaf, step.right_leaf,
                                     max_bin)
         return self._split_from_hist(hists, totals_g, totals_h, totals_c,
                                      can, num_bin, is_cat, feat_mask,
-                                     sp), cache
+                                     sp, bundle=bundle), cache
 
 
 class FeatureParallelComm(NamedTuple):
@@ -302,9 +313,36 @@ class FeatureParallelComm(NamedTuple):
     def prepare(self, bins, bins_rm, g, h, w, params):
         return None
 
+    def _expand_block(self, hist_blk, bundle, offset):
+        """EFB: expand this shard's COLUMN block back to the full
+        original-feature space.  Columns owned by other shards read a
+        zero pad column; their features come back as garbage and are
+        masked out of the scan (the split finder only trusts features
+        whose column this shard owns)."""
+        fb = self.f_block
+        owned = (bundle.col >= offset) & (bundle.col < offset + fb)
+        widths = [(0, 0)] * hist_blk.ndim
+        widths[hist_blk.ndim - 3] = (0, 1)
+        hist_pad = jnp.pad(hist_blk, widths)
+        local = bundle._replace(
+            col=jnp.where(owned, bundle.col - offset, fb))
+        return expand_histogram(hist_pad, local), owned
+
     def root_split(self, prep, bins, g, h, w, root_g, root_h, root_c,
                    num_bin, is_cat, feat_mask, max_bin: int, sp: SplitParams,
-                   num_leaves: int):
+                   num_leaves: int, bundle=None):
+        if bundle is not None:
+            shard = lax.axis_index(self.axis_name)
+            offset = shard * self.f_block
+            bins_blk = lax.dynamic_slice_in_dim(bins, offset, self.f_block,
+                                                axis=0)
+            hist = root_histogram(bins_blk, g, h, w, max_bin)
+            hist, owned = self._expand_block(hist, bundle, offset)
+            local = find_best_split(hist, root_g, root_h, root_c, num_bin,
+                                    is_cat, feat_mask & owned,
+                                    jnp.asarray(True), sp)
+            return _allgather_combine(local, self.axis_name,
+                                      self.num_shards), ()
         offset, nb, ic, fm = self._local_meta(num_bin, is_cat, feat_mask)
         bins_blk = lax.dynamic_slice_in_dim(bins, offset, self.f_block, axis=0)
         hist = root_histogram(bins_blk, g, h, w, max_bin)
@@ -316,7 +354,21 @@ class FeatureParallelComm(NamedTuple):
     def children_splits(self, prep, cache, bins, g, h, w, step,
                         totals_g, totals_h, totals_c, can,
                         num_bin, is_cat, feat_mask, max_bin: int,
-                        sp: SplitParams):
+                        sp: SplitParams, bundle=None):
+        if bundle is not None:
+            shard = lax.axis_index(self.axis_name)
+            offset = shard * self.f_block
+            bins_blk = lax.dynamic_slice_in_dim(bins, offset, self.f_block,
+                                                axis=0)
+            hists = children_histograms(bins_blk, g, h, w, step.leaf_id,
+                                        step.parent_leaf, step.right_leaf,
+                                        max_bin)
+            hists, owned = self._expand_block(hists, bundle, offset)
+            local = find_best_split(hists, totals_g, totals_h, totals_c,
+                                    num_bin, is_cat, feat_mask & owned,
+                                    can, sp)
+            return (_allgather_combine(local, self.axis_name,
+                                       self.num_shards), cache)
         offset, nb, ic, fm = self._local_meta(num_bin, is_cat, feat_mask)
         bins_blk = lax.dynamic_slice_in_dim(bins, offset, self.f_block, axis=0)
         hists = children_histograms(bins_blk, g, h, w, step.leaf_id,
@@ -453,8 +505,14 @@ class VotingParallelComm(NamedTuple):
 
     def root_split(self, prep, bins, g, h, w, root_g, root_h, root_c,
                    num_bin, is_cat, feat_mask, max_bin: int, sp: SplitParams,
-                   num_leaves: int):
+                   num_leaves: int, bundle=None):
         hist = root_histogram(bins, g, h, w, max_bin)
+        if bundle is not None:
+            # EFB: the election, votes and elected-feature psum all run
+            # in ORIGINAL feature space; only the local histogram pass
+            # ran over the shrunk columns — bundling multiplies with the
+            # voting learner's top-k comm reduction.
+            hist = expand_histogram(hist, bundle)
         best = self._elect_and_split(
             hist[None], jnp.asarray([root_g]), jnp.asarray([root_h]),
             jnp.asarray([root_c]), jnp.asarray([True]),
@@ -464,10 +522,12 @@ class VotingParallelComm(NamedTuple):
     def children_splits(self, prep, cache, bins, g, h, w, step,
                         totals_g, totals_h, totals_c, can,
                         num_bin, is_cat, feat_mask, max_bin: int,
-                        sp: SplitParams):
+                        sp: SplitParams, bundle=None):
         hists = children_histograms(bins, g, h, w, step.leaf_id,
                                     step.parent_leaf, step.right_leaf,
                                     max_bin)
+        if bundle is not None:
+            hists = expand_histogram(hists, bundle)
         return self._elect_and_split(hists, totals_g, totals_h, totals_c,
                                      can, num_bin, is_cat, feat_mask,
                                      sp), cache

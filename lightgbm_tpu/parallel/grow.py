@@ -64,7 +64,7 @@ def make_parallel_grow(mesh: Mesh, mode: str, params: GrowParams,
     # ledger now makes visible instead of silent)
     @instrumented_jit(program="dist_grow_tree")
     def grow(bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
-             learning_rate):
+             learning_rate, bundle=None):
         F, N = bins.shape
         pad_n = ((-N) % k) if row_sharded else 0
         pad_f = ((-F) % k) if mode == "feature" else 0
@@ -73,32 +73,43 @@ def make_parallel_grow(mesh: Mesh, mode: str, params: GrowParams,
             grad = jnp.pad(grad, (0, pad_n))
             hess = jnp.pad(hess, (0, pad_n))
             row_weight = jnp.pad(row_weight, (0, pad_n))  # 0 = dead row
-        if pad_f:
+        if pad_f and bundle is None:
+            # EFB keeps feature metadata in ORIGINAL space; only the
+            # column matrix pads (a zero pad column owns no feature)
             num_bin = jnp.pad(num_bin, (0, pad_f))
             is_cat = jnp.pad(is_cat, (0, pad_f))
             feat_mask = jnp.pad(feat_mask, (0, pad_f))  # False = dead feat
 
-        comm = make_comm(mode, axis_name, k, F + pad_f, top_k, hist_reduce)
+        comm = make_comm(mode, axis_name, k, F + pad_f, top_k,
+                         "psum" if bundle is not None else hist_reduce)
 
-        def local_fn(b, nb, ic, fm, g, h, w, lr):
-            return _grow_tree_impl(b, nb, ic, fm, g, h, w, lr, params, comm)
+        def local_fn(b, nb, ic, fm, g, h, w, lr, *bnd):
+            return _grow_tree_impl(b, nb, ic, fm, g, h, w, lr, params, comm,
+                                   bundle=bnd[0] if bnd else None)
 
-        sharded = shard_map(local_fn, mesh=mesh, in_specs=in_specs,
+        specs = in_specs if bundle is None else in_specs + (P(),)
+        sharded = shard_map(local_fn, mesh=mesh, in_specs=specs,
                             out_specs=out_specs)
-        tree, leaf_id, delta = sharded(bins, num_bin, is_cat, feat_mask,
-                                       grad, hess, row_weight, learning_rate)
+        args = (bins, num_bin, is_cat, feat_mask, grad, hess, row_weight,
+                learning_rate)
+        if bundle is not None:
+            args = args + (bundle,)
+        tree, leaf_id, delta = sharded(*args)
         if pad_n:
             leaf_id = leaf_id[:N]
             delta = delta[:N]
         return tree, leaf_id, delta
 
-    def traffic_per_tree(num_features: int):
+    def traffic_per_tree(num_features: int, bundled: bool = False):
         """Static per-tree collective traffic of this learner at the given
         (unpadded) feature count — the comm strategy's own account with
-        the same feature padding the jitted path applies (obs layer)."""
+        the same feature padding the jitted path applies (obs layer).
+        ``bundled`` mirrors the jitted path's EFB behavior: data-parallel
+        forces the full-histogram psum (the reduce-scatter block layout
+        cannot expand per shard), so the account must too."""
         pad_f = ((-num_features) % k) if mode == "feature" else 0
         comm = make_comm(mode, axis_name, k, num_features + pad_f, top_k,
-                         hist_reduce)
+                         "psum" if bundled else hist_reduce)
         return comm.traffic_per_tree(num_features + pad_f, params.max_bin,
                                      params.num_leaves)
 

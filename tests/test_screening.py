@@ -1,0 +1,201 @@
+"""EMA-FS gain-informed feature screening — pins (docs/SPARSE.md).
+
+Contract: screening disabled is the bit-identical baseline; enabled it
+keeps higgslike holdout AUC within 0.002 while masking a big share of
+the feature space; masks are runtime arguments and the compacted view
+rides a fixed shape budget, so mask toggles and refresh rounds record
+ZERO new XLA programs after warmup (the compile-ledger pin)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from lightgbm_tpu import obs
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.models.screening import GainScreener
+from lightgbm_tpu.obs import compile_ledger
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from bench import make_higgs_like  # noqa: E402
+
+pytestmark = pytest.mark.sparse
+
+
+def _auc(y, s):
+    order = np.argsort(s)
+    r = np.empty(len(s))
+    r[order] = np.arange(1, len(s) + 1)
+    pos = y > 0.5
+    npos, nneg = pos.sum(), (~pos).sum()
+    return (r[pos].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+
+
+def _train(X, y, extra=None, iters=40):
+    p = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 20,
+         "learning_rate": 0.1, "num_iterations": iters}
+    p.update(extra or {})
+    ds = BinnedDataset.from_matrix(X, y, max_bin=255, min_data_in_leaf=20)
+    b = GBDT(Config(p), ds)
+    for _ in range(iters):
+        b.train_one_iter()
+    b._flush_pending()
+    return b
+
+
+# ---------------------------------------------------------------------------
+# screener unit behavior
+# ---------------------------------------------------------------------------
+
+def test_schedule_warmup_refresh_screened():
+    s = GainScreener(8, 8, np.arange(8), ratio=0.5, refresh=4, warmup=3,
+                     decay=0.9)
+    modes = [s.round_mode(i) for i in range(12)]
+    assert modes[:3] == ["warmup"] * 3
+    assert modes[3] == "refresh"
+    assert modes[4:7] == ["screened"] * 3
+    assert modes[7] == "refresh"
+    assert s.period(4) == 0 and s.period(7) == 1 and s.period(8) == 1
+
+
+def test_active_columns_follow_gains():
+    s = GainScreener(6, 6, np.arange(6), ratio=0.5, refresh=4, warmup=0,
+                     decay=0.5)
+    s.ewma = np.array([0.0, 9.0, 1.0, 8.0, 0.1, 7.0])
+    cols = s.active_columns()
+    assert list(cols) == [1, 3, 5]            # top ceil(0.5*6)=3, sorted
+    mask = s.screen_mask(cols)
+    assert mask.tolist() == [False, True, False, True, False, True]
+
+
+def test_column_granularity_with_bundles():
+    # features 0,1 share column 0; feature 2 owns column 1: the column's
+    # score is the max member EWMA, and masks are column-granular
+    s = GainScreener(3, 2, np.array([0, 0, 1]), ratio=0.5, refresh=4,
+                     warmup=0, decay=0.5)
+    s.ewma = np.array([0.0, 5.0, 1.0])
+    cols = s.active_columns()
+    assert list(cols) == [0]                  # keep ceil(0.5*2)=1 column
+    assert s.screen_mask(cols).tolist() == [True, True, False]
+
+
+def test_ewma_update_from_trees():
+    class FakeTree:
+        num_leaves = 3
+        split_feature_inner = np.array([1, 4])
+        split_gain = np.array([10.0, 2.0])
+
+    s = GainScreener(6, 6, np.arange(6), ratio=0.5, refresh=4, warmup=0,
+                     decay=0.5)
+    s.observe_trees([FakeTree()])
+    assert s.ewma[1] == pytest.approx(5.0)
+    assert s.ewma[4] == pytest.approx(1.0)
+    assert s.ewma[0] == 0.0
+    state = s.state()
+    s2 = GainScreener(6, 6, np.arange(6), ratio=0.5, refresh=4, warmup=0,
+                      decay=0.5)
+    s2.restore(state)
+    assert np.array_equal(s2.ewma, s.ewma)
+
+
+# ---------------------------------------------------------------------------
+# training pins
+# ---------------------------------------------------------------------------
+
+def test_screening_disabled_is_bit_identical_baseline():
+    X, y = make_higgs_like(2500)
+    b0 = _train(X, y, iters=8)
+    b1 = _train(X, y, {"feature_screen_ratio": 0.0}, iters=8)
+    assert b1.save_model_to_string() == b0.save_model_to_string()
+
+
+def test_screening_keeps_higgslike_auc_within_pin():
+    X, y = make_higgs_like(12000)
+    Xt, yt, Xv, yv = X[:9000], y[:9000], X[9000:], y[9000:]
+    b0 = _train(Xt, yt, iters=40)
+    b1 = _train(Xt, yt, {"feature_screen_ratio": 0.25,
+                         "feature_screen_warmup": 15,
+                         "feature_screen_refresh": 5}, iters=40)
+    a0 = _auc(yv, b0.predict_raw(Xv)[0])
+    a1 = _auc(yv, b1.predict_raw(Xv)[0])
+    assert abs(a0 - a1) <= 0.002, (a0, a1)
+    # screening actually masked features on screened rounds
+    assert obs.get_gauge("screen_active_features") < X.shape[1]
+    assert obs.get_counter("screen_refresh_total") > 0
+
+
+def test_compile_ledger_flat_across_mask_and_refresh_toggles():
+    X, y = make_higgs_like(3000)
+    p = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 20,
+         "learning_rate": 0.1, "num_iterations": 40,
+         "feature_screen_ratio": 0.5, "feature_screen_warmup": 3,
+         "feature_screen_refresh": 3}
+    ds = BinnedDataset.from_matrix(X, y, max_bin=255, min_data_in_leaf=20)
+    b = GBDT(Config(p), ds)
+    # warm through: warmup rounds, the first refresh, the first screened
+    # round (the compacted view's one-time trace), and a second refresh
+    for _ in range(8):
+        b.train_one_iter()
+    jax.block_until_ready(b.train_data.score)
+    n0 = len(compile_ledger.events())
+    # many more rounds: the EWMA moves, masks toggle, the active set is
+    # re-drawn every refresh period, full refresh rounds interleave
+    for _ in range(14):
+        b.train_one_iter()
+    b._flush_pending()
+    jax.block_until_ready(b.train_data.score)
+    assert len(compile_ledger.events()) == n0
+
+
+def test_screening_composes_with_bundling():
+    from tests.test_bundling import one_hot_data
+    X, y = one_hot_data(n=2000, blocks=10, block_size=6, seed=13)
+    p = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 20,
+         "min_sum_hessian_in_leaf": 1e-3, "max_bin": 63,
+         "num_iterations": 12, "feature_screen_ratio": 0.4,
+         "feature_screen_warmup": 4, "feature_screen_refresh": 4}
+    ds = BinnedDataset.from_matrix(X, y, max_bin=63, min_data_in_leaf=20,
+                                   enable_bundle=True)
+    assert ds.bundle_plan is not None
+    b = GBDT(Config(p), ds)
+    for _ in range(12):
+        b.train_one_iter()
+    b._flush_pending()
+    assert np.isfinite(b.predict_raw(X[:200])).all()
+    assert obs.get_gauge("screen_active_features") <= ds.num_features
+
+
+def test_screener_state_rides_snapshots():
+    X, y = make_higgs_like(2500)
+    p = {"feature_screen_ratio": 0.3, "feature_screen_warmup": 2,
+         "feature_screen_refresh": 3}
+    b = _train(X, y, p, iters=6)
+    state = b.snapshot_state()
+    assert state["screen_state"] is not None
+    assert float(np.asarray(state["screen_state"]["ewma"]).sum()) > 0
+    ds = BinnedDataset.from_matrix(X, y, max_bin=255, min_data_in_leaf=20)
+    cfg = Config({"objective": "binary", "num_leaves": 15,
+                  "min_data_in_leaf": 20, "learning_rate": 0.1, **p})
+    b2 = GBDT(cfg, ds)
+    b2.restore_state(state)
+    assert np.array_equal(b2._screener.ewma, b._screener.ewma)
+
+
+def test_config_validates_screening_params():
+    with pytest.raises(ValueError):
+        Config({"feature_screen_ratio": 1.0})
+    with pytest.raises(ValueError):
+        Config({"feature_screen_ratio": -0.1})
+    with pytest.raises(ValueError):
+        Config({"feature_screen_refresh": 0})
+    with pytest.raises(ValueError):
+        Config({"feature_screen_warmup": -1})
+    with pytest.raises(ValueError):
+        Config({"feature_screen_decay": 0.0})
+    Config({"feature_screen_ratio": 0.5, "feature_screen_refresh": 2,
+            "feature_screen_warmup": 0, "feature_screen_decay": 1.0})

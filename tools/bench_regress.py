@@ -186,6 +186,17 @@ def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
         bad = obj.get("bad_rows")
         if isinstance(bad, dict) and bad:
             verdict[f"bad_rows_{side}"] = bad
+        # PR 14: wide-sparse training bill (docs/SPARSE.md) — EFB bundle
+        # shrinkage, screening's active-feature trajectory, and the run's
+        # AUC ride along informationally so an A/B ctrlike comparison
+        # (bundling/screening on vs off) shows its accuracy asterisk;
+        # never gated, never required (old baselines keep comparing)
+        for key in ("efb", "screening"):
+            blk = obj.get(key)
+            if isinstance(blk, dict) and blk:
+                verdict[f"{key}_{side}"] = blk
+        if obj.get("auc") is not None:
+            verdict[f"auc_{side}"] = float(obj["auc"])
     return verdict
 
 
