@@ -403,6 +403,26 @@ def main(dataset: str = "higgslike") -> None:
             "refresh_total": int(
                 _obs2.get_counter("screen_refresh_total")),
         }
+    # resource bill (PR 15, utils/resource.py + utils/diskguard.py):
+    # estimated vs measured peak bytes, degrade steps taken, sink write
+    # errors — a throughput number from a degraded run must carry its
+    # asterisk (bench_regress passes `resource` through informationally)
+    from lightgbm_tpu import obs as _obs_r
+    from lightgbm_tpu.obs import memwatch as _memwatch
+    from lightgbm_tpu.utils.resource import DEGRADE_STEPS as _STEPS
+    _mw = _memwatch.sample()
+    bench_json["resource"] = {
+        "estimated_peak_bytes": int(
+            _obs_r.get_gauge("hbm_train_estimate_bytes") or 0),
+        "measured_peak_bytes": int(
+            _mw.get("device_peak_bytes",
+                    _mw.get("peak_live_bytes", _mw.get("live_bytes", 0)))),
+        "degrade_steps": [s for s in _STEPS if _obs_r.get_counter(
+            "resource_degrade_" + s)],
+        "sink_write_errors": int(
+            _obs_r.get_counter("sink_write_errors_total")),
+        "device_oom": int(_obs_r.get_counter("device_oom_total")),
+    }
     # data-boundary bill (PR 13, io/guard.py): when a file-fed run
     # quarantined rows, say so in the BENCH JSON — a throughput number
     # from a partially-skipped dataset must carry its asterisk

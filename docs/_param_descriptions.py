@@ -224,6 +224,23 @@ DESC = {
     "snapshot_keep": "newest snapshot files retained (0 = keep all)",
     "nan_policy": "none | fail_fast | skip_tree — non-finite "
                   "gradient/score containment",
+    "memory_policy": "fail_fast | degrade — HBM admission control: an "
+                     "over-budget config either refuses up front with "
+                     "the per-component estimate table, or walks the "
+                     "footprint-reduction ladder (score donation → drop "
+                     "the leaf-histogram cache → cap the row-bucket "
+                     "pad) before refusing "
+                     "(docs/FAULT_TOLERANCE.md §Resource exhaustion)",
+    "sink_error_policy": "disable | fatal — what a guarded telemetry/"
+                         "state sink does on a classified write error "
+                         "(ENOSPC/EROFS/EDQUOT/EMFILE): disable itself "
+                         "with one warning + sink_write_errors_total, "
+                         "or raise a named SinkWriteError "
+                         "(docs/FAULT_TOLERANCE.md §Resource exhaustion)",
+    "events_flush_every": "events JSONL flush cadence in committed "
+                          "records — a crash loses at most this many "
+                          "trailing records (default 1: every record "
+                          "is on disk when note() returns)",
     "bad_data_policy": "fail_fast | quarantine — malformed input rows at "
                        "file load either raise a LightGBMError naming "
                        "file:line + token, or are skipped into "

@@ -685,7 +685,8 @@ def LGBM_BoosterPredictForFile(handle, data_filename, data_has_header,
                          has_header=bool(int(data_has_header)),
                          label_idx=cb.b.label_idx)
     out = cb.predict_mat(X, int(predict_type), int(num_iteration))
-    with open(_str(result_filename), "w") as fh:
+    from ..utils.diskguard import artifact_write
+    with artifact_write(_str(result_filename), "predict_output") as fh:
         if out.ndim == 1 or out.shape[1] == 1:
             for v in np.asarray(out).reshape(-1):
                 fh.write(f"{v:g}\n")

@@ -129,14 +129,27 @@ def run_predict(config: Config, params: Dict[str, str]) -> None:
         # bucketed device program instead of the per-tree host walk
         booster.compile(num_iteration=config.num_iteration_predict)
     n_rows = 0
-    with open(result_path, "w") as fh:
-        for part in booster.predict_chunks(
-                config.data, num_iteration=config.num_iteration_predict,
-                raw_score=config.is_predict_raw_score,
-                pred_leaf=pred_leaf, data_has_header=config.has_header):
-            part = np.asarray(part)
-            _write_prediction_rows(fh, part, pred_leaf)
-            n_rows += part.shape[0] if pred_leaf else part.shape[-1]
+    # the prediction stream is an ARTIFACT, not telemetry: a full disk
+    # must FAIL the task — but as a named diagnosis reporting how many
+    # rows landed before the write died, never a bare OSError backtrace
+    # (utils/diskguard.py; docs/FAULT_TOLERANCE.md §Resource exhaustion)
+    from .utils.diskguard import SinkWriteError, artifact_write
+    try:
+        with artifact_write(result_path, "predict_output") as fh:
+            for part in booster.predict_chunks(
+                    config.data,
+                    num_iteration=config.num_iteration_predict,
+                    raw_score=config.is_predict_raw_score,
+                    pred_leaf=pred_leaf, data_has_header=config.has_header):
+                part = np.asarray(part)
+                _write_prediction_rows(fh, part, pred_leaf)
+                n_rows += part.shape[0] if pred_leaf else part.shape[-1]
+    except SinkWriteError as exc:
+        log.fatal("task=predict: output stream %s died (%s) after %d "
+                  "row(s) were written; the partial result file is NOT "
+                  "a complete prediction — free space (or point "
+                  "output_result elsewhere) and re-run",
+                  result_path, exc.classification, n_rows)
     log.info("%f seconds elapsed, finished prediction of %d rows",
              time.monotonic() - start, n_rows)
     log.info("Finished prediction. Results saved to %s", result_path)
@@ -194,8 +207,11 @@ def main(argv=None) -> int:
     # persistent XLA compile cache for EVERY task (train also re-applies
     # inside engine.train; predict/serve only get it here): repeat CLI
     # invocations start hot (utils/compile_cache.py)
-    from .utils import compile_cache
+    from .utils import compile_cache, diskguard
     compile_cache.setup(config.compile_cache_dir or None)
+    # disk-full-safe sink policy for every task (train re-applies inside
+    # engine.train; predict/serve only get it here)
+    diskguard.set_default_policy(config.sink_error_policy or None)
     try:
         if config.task == "train":
             run_train(config, params)

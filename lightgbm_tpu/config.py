@@ -209,6 +209,16 @@ _DEFAULTS: Dict[str, Any] = {
     "snapshot_dir": "",        # where snapshots live; also enables resume
     "snapshot_keep": 3,        # newest files retained (0 = keep all)
     "nan_policy": "none",      # none | fail_fast | skip_tree
+    # resource exhaustion (utils/resource.py + utils/diskguard.py,
+    # docs/FAULT_TOLERANCE.md §Resource exhaustion)
+    "memory_policy": "fail_fast",  # fail_fast | degrade: refuse an
+                                   # over-budget config, or walk the
+                                   # footprint-reduction ladder first
+    "sink_error_policy": "disable",  # disable | fatal: what a guarded
+                                     # telemetry/state sink does on a
+                                     # classified write error (ENOSPC...)
+    "events_flush_every": 1,   # events JSONL flush cadence in committed
+                               # records (crash loses at most this many)
     # data boundary (io/guard.py; docs/FAULT_TOLERANCE.md §Data boundary)
     "bad_data_policy": "fail_fast",  # fail_fast | quarantine malformed
                                      # input rows at file load
@@ -407,6 +417,17 @@ class Config:
                 "(expected none, fail_fast, or skip_tree)")
         if v["snapshot_freq"] < 0:
             raise ValueError("snapshot_freq must be >= 0")
+        if v["memory_policy"] not in ("fail_fast", "degrade"):
+            raise ValueError(
+                f"Unknown memory_policy {v['memory_policy']} "
+                "(expected fail_fast or degrade)")
+        if v["sink_error_policy"] not in ("disable", "fatal"):
+            raise ValueError(
+                f"Unknown sink_error_policy {v['sink_error_policy']} "
+                "(expected disable or fatal)")
+        if v["events_flush_every"] < 1:
+            raise ValueError("events_flush_every must be >= 1 (flush "
+                             "after every K committed event records)")
         if not (0.0 <= v["max_conflict_rate"] < 1.0):
             raise ValueError(
                 "max_conflict_rate must be in [0, 1): it bounds the share "

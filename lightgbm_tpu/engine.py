@@ -66,6 +66,12 @@ def train(params, train_set, num_boost_round=100,
     _compile_ledger.configure(params.get("compile_ledger_file") or None)
     _memwatch.configure(params.get("memwatch"))
     _tracing.TRACER.configure(params.get("trace_events_file") or None)
+    # -- disk-full-safe sinks (utils/diskguard.py): each run's policy is
+    # authoritative, and sinks a previous run's full disk disabled are
+    # re-armed — this run may write to a different, healthy volume.
+    from .utils import diskguard as _diskguard
+    _diskguard.set_default_policy(params.get("sink_error_policy") or None)
+    _diskguard.reset_disabled()
     # -- crash-safe snapshot/resume (lightgbm_tpu/snapshot.py) ----------
     snapshot_dir = str(params.get("snapshot_dir") or "") or None
     try:
@@ -188,7 +194,12 @@ def train(params, train_set, num_boost_round=100,
     recorder = None
     if events_file:
         from .obs import EventRecorder
-        recorder = EventRecorder(str(events_file))
+        try:
+            flush_every = int(params.get("events_flush_every", 1) or 1)
+        except (TypeError, ValueError):
+            flush_every = 1
+        recorder = EventRecorder(str(events_file),
+                                 flush_every=flush_every)
         booster._booster.set_event_recorder(recorder)
 
     # callbacks (engine.py:113-142)

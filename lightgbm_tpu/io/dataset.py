@@ -400,7 +400,13 @@ class BinnedDataset:
             value = getattr(self.metadata, key)
             if value is not None:
                 arrays[key] = value
-        with open(path, "wb") as fh:
+        # atomic artifact write (utils/diskguard.py): the archive
+        # streams into <path>.tmp and os.replace-s on success, so a
+        # disk filling mid-save keeps the previous good cache file —
+        # without staging the (possibly multi-GB) archive in host RAM
+        from ..utils.diskguard import artifact_write
+        with artifact_write(path, "binary_dataset", mode="wb",
+                            atomic=True) as fh:
             fh.write(_BINARY_TOKEN)
             np.savez_compressed(fh, **arrays)
         log.info("Saved binary dataset to %s", path)

@@ -291,7 +291,15 @@ class IngestGuard:
         if not self._sink_enabled:
             return
         if self._sink is None:
-            self._sink = open(self.quarantine_path, "w")
+            # guarded writer (utils/diskguard.py): a full disk disables
+            # the quarantine SINK (warn-once + sink_write_errors_total)
+            # while the in-memory accounting and error budgets keep
+            # working — losing the sink file must not crash the load
+            # (policy=None honors the run's sink_error_policy)
+            from ..utils.diskguard import GuardedWriter
+            self._sink = GuardedWriter(self.quarantine_path,
+                                       sink="quarantine",
+                                       policy=None, buffering=1)
             self._sink.write(
                 "# lightgbm_tpu quarantine v1\n"
                 f"# source: {self.path}\n"

@@ -51,22 +51,35 @@ class _HistView(NamedTuple):
 
 def estimate_train_memory(num_data: int, num_features: int, num_leaves: int,
                           max_bin: int, num_models: int,
-                          bin_itemsize: int = 1) -> Dict[str, int]:
+                          bin_itemsize: int = 1, *,
+                          donate_score: bool = False,
+                          fused_scratch: bool = False,
+                          leaf_cache: bool = True) -> Dict[str, int]:
     """Rough per-device HBM footprint (bytes) of training, by component.
 
     The dense-on-device design (SURVEY §7.2) has no sparse-bin fallback
     (reference sparse_bin.hpp stores sparse data ~20x smaller) and keeps
     the per-leaf histogram cache fully resident instead of LRU-bounding it
     (reference HistogramPool, feature_histogram.hpp:299-455) — so unlike
-    the reference, an oversize problem cannot spill; it must fail fast at
-    construction with this estimate instead of dying in XLA allocation.
+    the reference, an oversize problem cannot spill; the admission gate
+    (``_check_memory_budget`` + ``utils/resource.py``,
+    docs/FAULT_TOLERANCE.md §Resource exhaustion) must refuse or degrade
+    at construction with this estimate instead of dying in XLA
+    allocation.
 
     Components mirror what training actually allocates: column- and
     row-major bin copies (+ word-packed lanes for the ordered grower,
     padded to the largest window class), the 9-stream int8 digit payload,
-    per-class score buffers, and the [L, F, 9, B] int32 histogram cache.
-    ``working`` doubles the sort payload: lax.sort and the window
-    update-slices hold one extra copy of their operands live."""
+    per-class score buffers, the [L, F, 9, B] int32 histogram cache
+    (``leaf_cache=False`` — the fused kernel and the ``hist_cache``
+    degrade step — zeroes it), the score-update double buffer
+    (``donate_score=True`` — in-place XLA aliasing — zeroes it), and the
+    fused kernel's VMEM scratch (``fused_scratch``: both children's
+    histogram tiles live in VMEM during the pass instead of HBM).
+    ``num_data`` is the PADDED row count when row bucketing is on — the
+    pad rows allocate like real ones.  ``working`` doubles the sort
+    payload: lax.sort and the window update-slices hold one extra copy
+    of their operands live."""
     from ..ops.ordered_grow import _size_classes
 
     n, f = num_data, num_features
@@ -79,15 +92,25 @@ def estimate_train_memory(num_data: int, num_features: int, num_leaves: int,
     # score, grad, hess, and the per-class prediction delta are all live
     # at once at the peak of a boosting step
     scores = num_models * n * 4 * 4
-    cache = num_leaves * f * 9 * max_bin * 4
+    # without donation XLA materializes the updated [K, N] score cache
+    # NEXT TO the old one at the update peak
+    double_buf = 0 if donate_score else num_models * n * 4
+    cache = (num_leaves * f * 9 * max_bin * 4) if leaf_cache else 0
+    # fused histogram->split-gain kernel: both children's [F, B, 3] f32
+    # tiles are scratch resident during the pass (never landed in HBM,
+    # but the budget must still cover them — VMEM pressure spills)
+    vmem = (2 * f * max_bin * 3 * 4) if fused_scratch else 0
     payload = bins_words + digits
     return {
         "bins_device": bins_cm + bins_rm,
         "packed_payload": payload,
         "scores_and_gradients": scores,
+        "score_double_buffer": double_buf,
         "histogram_cache": cache,
+        "vmem_scratch": vmem,
         "working": payload,
-        "total": bins_cm + bins_rm + 2 * payload + scores + cache,
+        "total": (bins_cm + bins_rm + 2 * payload + scores + double_buf
+                  + cache + vmem),
     }
 
 
@@ -284,6 +307,15 @@ def _donation_enabled() -> bool:
     env = os.environ.get("LIGHTGBM_TPU_DONATION", "").strip().lower()
     if env:
         return env in ("1", "true", "yes", "on")
+    return _donation_safe()
+
+
+def _donation_safe() -> bool:
+    """Whether the backend's input-output aliasing is trustworthy at all
+    (accelerators yes, XLA:CPU no — see ``_donation_enabled``).  The
+    ``score_donation`` degrade step may re-enable donation an env
+    override turned off, but never on a backend where aliasing corrupts
+    buffers: a memory degrade must not trade OOM for wrong answers."""
     try:
         return jax.default_backend() != "cpu"
     except Exception:  # pragma: no cover - backend not initialized
@@ -352,6 +384,7 @@ def _build_shared_train_step(objective, num_class: int, guard: bool,
     ``kind`` picks the serial growth strategy; the inner grow jits
     inline under this trace (obs/compile_ledger.py passthrough)."""
     fused_comm = SerialComm(leaf_cache=False, fused_gain=True)
+    nocache_comm = SerialComm(leaf_cache=False)
 
     def step_fn(score, feat_masks, row_weight, lr, bins, num_bin, is_cat,
                 grad_arrays, bins_rm, bins_words, bundle):
@@ -370,6 +403,11 @@ def _build_shared_train_step(objective, num_class: int, guard: bool,
             elif kind == "fused":
                 ta, _, delta = grow_tree(*args, params, fused_comm, bins_rm,
                                          bundle=bundle)
+            elif kind == "nocache":
+                # hist_cache degrade step: full-pass growth, no resident
+                # [L, F, 9, B] cache (memory_policy=degrade)
+                ta, _, delta = grow_tree(*args, params, nocache_comm,
+                                         bins_rm, bundle=bundle)
             else:
                 ta, _, delta = grow_tree(*args, params, bins_rm=bins_rm,
                                          bundle=bundle)
@@ -432,6 +470,11 @@ class GBDT:
     # -- fault tolerance (docs/FAULT_TOLERANCE.md) ----------------------
     _nan_policy = "none"          # none | fail_fast | skip_tree
     _nan_skips = 0                # poisoned iterations dropped (skip_tree)
+    # -- resource degrade ladder (memory_policy=degrade; utils/resource.py,
+    # docs/FAULT_TOLERANCE.md §Resource exhaustion) ---------------------
+    _degrade_steps: Tuple[str, ...] = ()   # applied steps, in order
+    _degrade_force_donate = False  # score_donation step fired
+    _degrade_leaf_cache_off = False  # hist_cache step fired
 
     def __init__(self, config: Config, train_set: Optional[BinnedDataset],
                  objective: Optional[ObjectiveFunction] = None):
@@ -596,6 +639,12 @@ class GBDT:
         cfg = self.config
         if cfg.serial_grow == "fused":
             return "fused"
+        # the hist_cache degrade step (memory_policy=degrade) dropped
+        # the per-leaf histogram cache: route through the cacheless
+        # full-pass learner (exact parity with the cached one — both
+        # scan the same histograms; only the reuse strategy differs)
+        if self._degrade_leaf_cache_off:
+            return "nocache"
         # EFB columns and screening's compacted views both need the
         # per-split column decode, which the leaf-ordered grower's packed
         # word lanes do not carry — route to the cached learner (exact
@@ -615,47 +664,173 @@ class GBDT:
             return "ordered"
         return "cached"
 
+    # -- HBM admission control (docs/FAULT_TOLERANCE.md §Resource
+    # exhaustion).  The estimate/gate/degrade machinery is host-side
+    # arithmetic by construction: ZERO new XLA programs (ledger-pinned
+    # by tests/test_resource_chaos.py).
+
+    def _estimate_now(self, cfg: Config, train_set: BinnedDataset,
+                      guard: bool) -> Dict[str, int]:
+        """The training estimate under the CURRENT construction state —
+        re-evaluated after each degrade step so the ladder can stop as
+        soon as the footprint fits."""
+        fused = cfg.serial_grow == "fused"
+        return estimate_train_memory(
+            self._padded_rows, train_set.num_columns, cfg.num_leaves,
+            cfg.max_bin, self.num_class,
+            bin_itemsize=train_set.bins.dtype.itemsize,
+            donate_score=not guard and self._donation_on(),
+            fused_scratch=fused,
+            leaf_cache=not fused and not self._degrade_leaf_cache_off)
+
+    def _donation_on(self) -> bool:
+        """This booster's round-to-round donation decision (before the
+        nan-guard veto): the env/default gate, plus the ``score_donation``
+        degrade step's override — which only ever fires where
+        ``_donation_safe`` says aliasing is trustworthy."""
+        if self._degrade_force_donate and _donation_safe():
+            return True
+        return _donation_enabled()
+
     def _check_memory_budget(self, cfg: Config,
                              train_set: BinnedDataset) -> None:
-        """Fail fast (with a breakdown) when the dense-on-device training
-        state cannot fit the device, instead of dying later in an XLA
-        allocation error; warn loudly when ``histogram_pool_size`` asks
-        for an LRU bound the resident-cache design does not provide
-        (reference feature_histogram.hpp:299-455)."""
-        est = estimate_train_memory(
-            getattr(self, "_padded_rows", train_set.num_data),
-            train_set.num_columns, cfg.num_leaves,
-            cfg.max_bin, self.num_class,
-            bin_itemsize=train_set.bins.dtype.itemsize)
+        """Pre-flight HBM admission gate: compare the per-component
+        estimate against the device budget and apply ``memory_policy``:
+
+        - ``fail_fast`` (default): refuse an over-budget config with a
+          named ``MemoryBudgetExceeded`` carrying the component table —
+          instead of dying hours later in an opaque XLA allocation;
+        - ``degrade``: walk the documented footprint ladder
+          (``utils/resource.py DEGRADE_STEPS``) — re-enable score
+          donation where safe (drops the score double buffer), drop the
+          per-leaf histogram cache (children recompute instead of
+          sibling-subtraction; also honors ``histogram_pool_size`` as a
+          real bound), cap the row-bucket pad — one ``warn_once`` +
+          ``resource_degrade_*`` counter per applied step, refusing only
+          if the ladder bottoms out still over budget."""
+        from ..utils import resource
+        guard = str(getattr(cfg, "nan_policy", "none") or "none") != "none"
+        policy = resource.check_memory_policy(
+            getattr(cfg, "memory_policy", "fail_fast"))
+        est = self._estimate_now(cfg, train_set, guard)
+        pool_mb = float(getattr(cfg, "histogram_pool_size", -1.0) or -1.0)
+        if pool_mb > 0 and est["histogram_cache"] > pool_mb * (1 << 20):
+            if policy == "degrade":
+                # the reference's HistogramPool bound, honored the only
+                # way fixed-shape jits can: the resident cache goes away
+                # entirely and children recompute their histograms
+                self._apply_degrade(
+                    "hist_cache", est["histogram_cache"],
+                    f"histogram_pool_size={pool_mb:g}MB bounds the "
+                    f"per-leaf histogram cache "
+                    f"({est['histogram_cache'] / (1 << 20):.0f}MB "
+                    f"resident): dropping the cache — children "
+                    f"recompute instead of sibling-subtraction")
+                est = self._estimate_now(cfg, train_set, guard)
+            else:
+                log.warn_once(
+                    "histogram_pool_size",
+                    "histogram_pool_size=%.0fMB requested but the TPU "
+                    "design keeps the whole per-leaf histogram cache "
+                    "resident (%.0fMB for num_leaves=%d x %d columns x 9 "
+                    "x %d bins); under memory_policy=fail_fast the "
+                    "parameter does NOT bound memory — lower "
+                    "num_leaves/max_bin, or set memory_policy=degrade "
+                    "to make the bound real", pool_mb,
+                    est["histogram_cache"] / (1 << 20), cfg.num_leaves,
+                    train_set.num_columns, cfg.max_bin)
+        limit = _device_memory_limit()
+        obs.set_gauge("hbm_budget_bytes", int(limit) if limit else -1)
+        if limit and est["total"] > limit and policy == "degrade":
+            est = self._walk_degrade_ladder(cfg, train_set, guard, est,
+                                            limit)
         obs.set_gauge("hbm_train_estimate_bytes", int(est["total"]))
         obs.set_gauge("hbm_histogram_cache_bytes",
                       int(est["histogram_cache"]))
-        pool_mb = float(getattr(cfg, "histogram_pool_size", -1.0) or -1.0)
-        if pool_mb > 0 and est["histogram_cache"] > pool_mb * (1 << 20):
-            log.warn_once(
-                "histogram_pool_size",
-                "histogram_pool_size=%.0fMB requested but the TPU design "
-                "keeps the whole per-leaf histogram cache resident "
-                "(%.0fMB for num_leaves=%d x %d columns x 9 x %d bins); "
-                "the parameter is accepted for config compatibility and "
-                "does NOT bound memory — lower num_leaves/max_bin to "
-                "shrink the cache", pool_mb,
-                est["histogram_cache"] / (1 << 20), cfg.num_leaves,
-                train_set.num_columns, cfg.max_bin)
+        # publish the table for the DeviceOOM diagnosis (the gate's
+        # prediction next to what the allocator saw)
+        resource.set_budget_table(
+            est, f"train rows={self._padded_rows} "
+                 f"cols={train_set.num_columns} "
+                 f"leaves={cfg.num_leaves} bins={cfg.max_bin}")
+        if limit and est["total"] > limit:
+            raise resource.refuse(est, limit, "training",
+                                  self._degrade_steps)
         # running account for add_valid_dataset's incremental re-check
         self._train_mem_est = int(est["total"])
         self._valid_mem_bytes = 0
-        limit = _device_memory_limit()
-        obs.set_gauge("hbm_budget_bytes", int(limit) if limit else -1)
-        if limit and est["total"] > limit:
-            parts = ", ".join(f"{k}={v / (1 << 20):.0f}MB"
-                              for k, v in est.items() if k != "total")
-            log.fatal(
-                "estimated training memory %.0fMB exceeds the device "
-                "budget %.0fMB (%s).  The dense-only design has no sparse "
-                "spill (SURVEY §7.2): shrink num_leaves/max_bin or train "
-                "on fewer rows.", est["total"] / (1 << 20),
-                limit / (1 << 20), parts)
+
+    def _apply_degrade(self, step: str, saved_bytes: int,
+                       detail: str) -> None:
+        from ..utils import resource
+        if step == "score_donation":
+            self._degrade_force_donate = True
+        elif step == "hist_cache":
+            self._degrade_leaf_cache_off = True
+        elif step == "row_pad":
+            self._padded_rows = self.num_data
+        self._degrade_steps = self._degrade_steps + (step,)
+        resource.note_degrade(step, saved_bytes, detail)
+
+    def _walk_degrade_ladder(self, cfg: Config, train_set: BinnedDataset,
+                             guard: bool, est: Dict[str, int],
+                             limit: int) -> Dict[str, int]:
+        """Apply the footprint ladder in order until the estimate fits
+        (or every available step is spent).  Unavailable steps (nan
+        guard pins the rollback buffer, CPU aliasing is unsafe, pad
+        already zero) are skipped with a debug line — degrading must
+        never trade memory for wrong answers."""
+        from ..utils import resource
+        for step in resource.DEGRADE_STEPS:
+            if est["total"] <= limit:
+                break
+            if step == "score_donation":
+                if guard or self._donation_on() or not _donation_safe():
+                    log.debug("degrade step score_donation unavailable "
+                              "(guard=%s, donation already on=%s, "
+                              "backend aliasing safe=%s)", guard,
+                              self._donation_on(), _donation_safe())
+                    continue
+                saved = est["score_double_buffer"]
+                detail = ("re-enabling in-place score-buffer donation "
+                          "(the [num_class, N] cache updates in place "
+                          "instead of double-allocating)")
+            elif step == "hist_cache":
+                if self._degrade_leaf_cache_off \
+                        or cfg.serial_grow == "fused" \
+                        or est["histogram_cache"] <= 0:
+                    continue
+                saved = est["histogram_cache"]
+                detail = ("dropping the [L, F, 9, B] per-leaf histogram "
+                          "cache — children recompute instead of "
+                          "sibling-subtraction (slower, never wrong)")
+            elif step == "row_pad":
+                if self._padded_rows <= self.num_data:
+                    continue
+                pad = self._padded_rows - self.num_data
+                saved = est["total"] - self._estimate_probe_rows(
+                    cfg, train_set, guard)["total"]
+                detail = (f"capping the row-bucket pad ({pad} pad rows "
+                          f"released; this run compiles per-N programs "
+                          f"instead of sharing the bucket ladder)")
+            else:  # pragma: no cover - DEGRADE_STEPS is closed
+                continue
+            self._apply_degrade(step, max(int(saved), 0), detail)
+            est = self._estimate_now(cfg, train_set, guard)
+        return est
+
+    def _estimate_probe_rows(self, cfg: Config, train_set: BinnedDataset,
+                             guard: bool) -> Dict[str, int]:
+        """The estimate as it WOULD look with the pad capped (savings
+        math for the ``row_pad`` step, without mutating state yet)."""
+        fused = cfg.serial_grow == "fused"
+        return estimate_train_memory(
+            self.num_data, train_set.num_columns, cfg.num_leaves,
+            cfg.max_bin, self.num_class,
+            bin_itemsize=train_set.bins.dtype.itemsize,
+            donate_score=not guard and self._donation_on(),
+            fused_scratch=fused,
+            leaf_cache=not fused and not self._degrade_leaf_cache_off)
 
     @staticmethod
     def _make_grow_params(cfg: Config) -> GrowParams:
@@ -766,6 +941,13 @@ class GBDT:
             return (lambda view, nb, ic, fm, g, h, w, lr:
                     grow_tree(view.bins, nb, ic, fm, g, h, w, lr, params,
                               comm, view.bins_rm, bundle=view.bundle))
+        if kind == "nocache":
+            # hist_cache degrade step (memory_policy=degrade): full-pass
+            # growth without the resident per-leaf histogram cache
+            comm = SerialComm(leaf_cache=False)
+            return (lambda view, nb, ic, fm, g, h, w, lr:
+                    grow_tree(view.bins, nb, ic, fm, g, h, w, lr, params,
+                              comm, view.bins_rm, bundle=view.bundle))
         if cfg.serial_grow == "ordered" and self._bundle is None \
                 and self._screener is None:
             log.info("max_bin > 256: using the cached (original-order) "
@@ -837,6 +1019,15 @@ class GBDT:
                              if self._row_buckets_enabled(cfg)
                              and not self.objective.uses_legacy_gradients()
                              else self.num_data)
+        # re-run the HBM admission gate against the NEW dataset: the
+        # recomputed pad would otherwise silently undo a row_pad degrade
+        # step, and a larger reset dataset must be refused/degraded here
+        # — not hours later in an opaque XLA RESOURCE_EXHAUSTED.  The
+        # valid-set accounting survives the gate's reset (valid sets
+        # are not touched by a training-data swap).
+        valid_bytes = getattr(self, "_valid_mem_bytes", 0)
+        self._check_memory_budget(cfg, train_set)
+        self._valid_mem_bytes = valid_bytes
         self.train_data = _DeviceData(train_set, self.num_class,
                                       with_row_major=True,
                                       padded_rows=self._padded_rows)
@@ -1084,7 +1275,7 @@ class GBDT:
             return self._make_train_step_local(guard)
         jit = _shared_train_step(self.objective, self.num_class, guard,
                                  self._serial_grow_kind(), self.grow_params,
-                                 donate=not guard and _donation_enabled())
+                                 donate=not guard and self._donation_on())
         num_bin, is_cat = self.num_bin, self.is_cat
         grad_arrays = self._grad_arrays
 
@@ -1423,8 +1614,9 @@ class GBDT:
         # undo (which NaN would defeat: x + NaN - NaN != x).
         guard = self._nan_policy != "none"
         # one donation decision per round: rollback references and the
-        # backend gate both veto in-place score updates
-        donate = not guard and _donation_enabled()
+        # backend gate both veto in-place score updates (the
+        # score_donation degrade step may re-enable an env opt-out)
+        donate = not guard and self._donation_on()
         poisoned = None               # which check tripped, for diagnostics
         if guard:
             score0 = self.train_data.score
@@ -1991,8 +2183,14 @@ class GBDT:
         return buf.getvalue()
 
     def save_model_to_file(self, path: str, num_iteration: int = -1) -> None:
-        with open(path, "w") as fh:
-            fh.write(self.save_model_to_string(num_iteration))
+        # atomic artifact write (utils/diskguard.py): a full disk fails
+        # the save with a named, classified error, and the tmp+replace
+        # protocol keeps the PREVIOUS good file — never a half-written
+        # model mistaken for a good one, never a truncated-in-place
+        # last-good destroyed by the failure
+        from ..utils.diskguard import write_artifact_atomic
+        text = self.save_model_to_string(num_iteration)
+        write_artifact_atomic(path, text.encode(), "model_file")
 
     def feature_importance(self):
         """Split-count importance (gbdt.cpp:765-789)."""

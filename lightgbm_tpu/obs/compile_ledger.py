@@ -133,14 +133,13 @@ def record(program: str, shapes: str, seconds: float) -> Dict[str, Any]:
             _dropped += 1
         path = _path
     if path:
-        try:
-            with open(path, "a") as fh:
-                fh.write(json.dumps(ev) + "\n")
-        except OSError as exc:  # the account must never kill the run
-            from ..utils import log
-            log.warn_once("compile_ledger_write",
-                          "compile ledger %s not writable (%s); events "
-                          "stay in-memory only", path, exc)
+        # guarded append (utils/diskguard.py): a full disk degrades the
+        # ledger to in-memory-only with one warning and a
+        # sink_write_errors_total count — the account must never kill
+        # the run it measures (unless the run asked for
+        # sink_error_policy=fatal; policy=None honors it)
+        from ..utils import diskguard
+        diskguard.append_line(path, json.dumps(ev), sink="compile_ledger")
     return ev
 
 
@@ -233,14 +232,35 @@ class InstrumentedJit:
         except Exception:  # pragma: no cover - jax internals moved
             return None
 
+    def _dispatch(self, *args, **kwargs):
+        """The one seam every instrumented dispatch passes through —
+        where ``testing.faults.oom_on_program`` injects and where a real
+        XLA ``RESOURCE_EXHAUSTED`` surfaces."""
+        return self._fn(*args, **kwargs)
+
+    def _call_guarded(self, *args, **kwargs):
+        """Dispatch with device-OOM containment: an XLA
+        ``RESOURCE_EXHAUSTED`` escaping this program is re-raised as a
+        named ``DeviceOOM`` diagnosis (utils/resource.py) carrying the
+        program name, the abstract shapes of THIS call, a memwatch
+        snapshot and the last admission table — instead of the raw
+        allocator backtrace."""
+        try:
+            return self._dispatch(*args, **kwargs)
+        except Exception as exc:
+            from ..utils import resource
+            resource.reraise_if_oom(exc, self.program,
+                                    abstract_shapes(args, kwargs))
+            raise
+
     def _call_counted(self, *args, **kwargs):
         """Run the jit; returns ``(out, compiled)`` and records the
         ledger event when the call compiled."""
         if _in_trace():
-            return self._fn(*args, **kwargs), False
+            return self._call_guarded(*args, **kwargs), False
         before = self._cache_size()
         t0 = time.perf_counter()
-        out = self._fn(*args, **kwargs)
+        out = self._call_guarded(*args, **kwargs)
         dt = time.perf_counter() - t0
         after = self._cache_size()
         if after is not None:

@@ -53,12 +53,25 @@ def _sanitize(v):
 
 
 class EventRecorder:
-    """Append-only JSONL writer with per-iteration field merging."""
+    """Append-only JSONL writer with per-iteration field merging.
 
-    def __init__(self, path: str):
+    The sink is a ``diskguard.GuardedWriter`` (line-buffered, flushed
+    every ``flush_every`` committed records — default every record), so
+    (a) a crashed run keeps every record committed before the crash: the
+    tail of exactly the iterations you need to debug the crash is on
+    disk, not in a userspace buffer (pinned by
+    tests/test_resource_chaos.py's kill-without-close test), and (b) a
+    full disk mid-run disables the stream with one warning and a
+    ``sink_write_errors_total`` count instead of crashing training from
+    inside its own telemetry (docs/FAULT_TOLERANCE.md §Resource
+    exhaustion)."""
+
+    def __init__(self, path: str, flush_every: int = 1):
         self._path = str(path)
+        self._flush_every = max(int(flush_every), 1)
         self._pending: Dict[int, Dict[str, Any]] = {}
         self._written = 0
+        self._since_flush = 0
         # multihost: stamp every record with this process's rank so
         # obs-report over merged per-rank files can attribute stragglers
         # (single-process streams stay unchanged — no rank field).  The
@@ -76,7 +89,18 @@ class EventRecorder:
                 self._path = f"{root}.rank{rank}{ext or '.jsonl'}"
         except Exception:
             pass
-        self._fh = open(self._path, "w")
+        from ..utils.diskguard import GuardedWriter
+        # policy=None: honor the run's sink_error_policy (disable by
+        # default; fatal for runs where lost telemetry is unacceptable).
+        # Line-buffered only at the every-record cadence — with a
+        # flush_every batch the block buffer is the point (one syscall
+        # per cadence, not per record).
+        self._fh = GuardedWriter(self._path, sink="events", policy=None,
+                                 buffering=1 if self._flush_every == 1
+                                 else -1)
+        # eager create: readers (obs-report, tests) expect the stream
+        # file to exist from the moment the run starts
+        self._fh.touch()
 
     # -- producers -------------------------------------------------------
     def note(self, iteration: int, **fields: Any) -> None:
@@ -101,10 +125,15 @@ class EventRecorder:
         if self._rank is not None:
             line["rank"] = self._rank
         line.update(rec)
-        self._fh.write(json.dumps(_sanitize(line), default=_json_default)
-                       + "\n")
-        self._fh.flush()
+        ok = self._fh.write(
+            json.dumps(_sanitize(line), default=_json_default) + "\n")
+        if not ok:
+            return              # sink disabled (disk full): drop, run on
         self._written += 1
+        self._since_flush += 1
+        if self._since_flush >= self._flush_every:
+            self._fh.flush()
+            self._since_flush = 0
 
     def close(self) -> None:
         """Commit all pending records (ascending) and close the file."""

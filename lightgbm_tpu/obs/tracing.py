@@ -219,9 +219,9 @@ class Tracer:
                                "displayTimeUnit": "ms"}
         if dropped:
             doc["otherData"] = {"dropped_oldest_events": dropped}
-        with open(path, "w") as fh:
-            json.dump(doc, fh)
-        return path
+        from ..utils import diskguard
+        return diskguard.write_text(path, json.dumps(doc),
+                                    sink="trace_events")
 
     def maybe_export(self) -> Optional[str]:
         """Export to the configured path if armed, then CLEAR the event
@@ -231,13 +231,14 @@ class Tracer:
         if not self.enabled or not self.path:
             return None
         n = len(self._events)
+        from ..utils.diskguard import SinkWriteError
         try:
             out = self.export()
-        except OSError as exc:
-            from ..utils import log
-            log.warn_once("trace_events_write",
-                          "trace events file %s not writable: %s",
-                          self.path, exc)
+        except (SinkWriteError, OSError):
+            # classified + counted + warned by diskguard; the tracer
+            # DISABLES itself — re-collecting spans for a sink that
+            # cannot land them only grows the ring buffer for nothing
+            self.enabled = False
             return None
         if out:
             from ..utils import log

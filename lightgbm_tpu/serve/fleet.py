@@ -838,22 +838,24 @@ class ModelManager:
         reload that already succeeded."""
         if not self.state_file:
             return
+        from ..utils import diskguard
         try:
             state = self.read_state(self.state_file)
             state[str(target)] = {"model": str(model_path),
                                   "generation": int(generation),
                                   "t": round(time.time(), 3)}
-            directory = os.path.dirname(self.state_file)
-            if directory:
-                os.makedirs(directory, exist_ok=True)
-            tmp = self.state_file + ".tmp"
-            with open(tmp, "w") as fh:
-                json.dump(state, fh)
-            os.replace(tmp, self.state_file)
+            # atomic + last-good (utils/diskguard.py): on a full disk
+            # the orphaned .tmp is removed and the PREVIOUS state file
+            # survives, so a restart still boots the last model that
+            # successfully recorded — and the next reload retries
+            diskguard.write_file_atomic(
+                self.state_file, json.dumps(state).encode(),
+                sink="serve_state", fsync=False)
         except OSError as exc:
-            log.warn_once("serve_state_write",
-                          "serve state file %s not writable (%s); restart "
-                          "will boot from input_model", self.state_file, exc)
+            diskguard.note_sink_error(
+                "serve_state", self.state_file, exc,
+                action="the last-good state file is kept; the next "
+                "successful reload retries the write")
 
     @staticmethod
     def read_state(state_file: str) -> Dict[str, Any]:

@@ -80,19 +80,15 @@ def _encode(state: Dict[str, Any]) -> bytes:
 
 def write_snapshot(path: str, state: Dict[str, Any]) -> str:
     """Atomically write ``state`` to ``path`` (tmp file + fsync +
-    ``os.replace``): a crash at any byte leaves either the previous file
-    or a ``.tmp`` that the checksummed reader ignores."""
-    blob = _encode(state)
-    directory = os.path.dirname(path)
-    if directory:
-        os.makedirs(directory, exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as fh:
-        fh.write(blob)
-        fh.flush()
-        os.fsync(fh.fileno())
-    os.replace(tmp, path)
-    return path
+    ``os.replace`` via ``utils/diskguard.write_file_atomic``): a crash
+    at any byte leaves either the previous file or a ``.tmp`` the
+    checksummed reader ignores, and a WRITE failure (ENOSPC mid-fsync)
+    removes the orphaned ``.tmp`` and leaves the last-good file intact
+    before the ``OSError`` propagates — ``save_snapshot`` turns it into
+    warn + retry-on-the-next-interval."""
+    from .utils import diskguard
+    return diskguard.write_file_atomic(path, _encode(state),
+                                       sink="snapshot")
 
 
 def read_snapshot(path: str) -> Optional[Dict[str, Any]]:
@@ -155,8 +151,23 @@ def load_latest_snapshot(directory: str) \
 
 
 def prune_snapshots(directory: str, keep: int) -> None:
-    """Delete all but the newest ``keep`` snapshot files (best-effort).
-    ``keep <= 0`` disables pruning."""
+    """Delete all but the newest ``keep`` snapshot files (best-effort);
+    ``keep <= 0`` disables that half.  ALWAYS sweeps orphaned
+    ``snapshot_*.bin.tmp`` files: a write that died before its
+    ``os.replace`` (hard crash mid-fsync) leaves one behind, and stale
+    tmps would otherwise accumulate per retry on a full disk.  Safe
+    because one rank owns the directory (``is_snapshot_writer``) and
+    the sweep runs in the writer's own thread, never concurrently with
+    a live write."""
+    try:
+        for name in os.listdir(directory):
+            if _FILE_RE.match(name[:-4]) and name.endswith(".tmp"):
+                try:
+                    os.remove(os.path.join(directory, name))
+                except OSError:
+                    pass
+    except OSError:
+        pass
     if keep <= 0:
         return
     for _, path in list_snapshots(directory)[keep:]:
@@ -277,7 +288,20 @@ def save_snapshot(directory: str, booster, rounds_done: int,
                       "replicated); this rank skips the write")
         return None
     state = capture_booster_state(booster, rounds_done, evals_result)
-    path = write_snapshot(snapshot_path(directory, rounds_done), state)
+    try:
+        path = write_snapshot(snapshot_path(directory, rounds_done), state)
+    except OSError as exc:
+        # resource exhaustion on the snapshot sink must not kill the
+        # training run it protects: the last-good snapshot is intact
+        # (write_file_atomic removed the torn .tmp), this interval's
+        # write is skipped, and the NEXT snapshot_freq interval retries
+        from .utils import diskguard
+        diskguard.note_sink_error(
+            "snapshot", snapshot_path(directory, rounds_done), exc,
+            action="the last-good snapshot is kept; the write retries "
+            "on the next snapshot_freq interval")
+        prune_snapshots(directory, keep)   # sweep any stale .tmp now
+        return None
     prune_snapshots(directory, keep)
     return path
 

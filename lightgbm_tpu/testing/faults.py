@@ -43,6 +43,16 @@ fragments:
   deterministic and returning the ground-truth line numbers the
   quarantine accounting is checked against.
 
+- resource-exhaustion injectors (PR 15, the resource chaos suite in
+  tests/test_resource_chaos.py, marker ``resource_chaos``):
+  ``fail_writes(errno, path_glob)`` (every guarded write raises — the
+  already-full/read-only/quota'd/fd-starved disk, through the ONE
+  diskguard hook every non-artifact sink funnels through),
+  ``disk_full_after(n_bytes)`` (the volume filling up mid-run),
+  ``oom_on_program(name)`` (RESOURCE_EXHAUSTED at the InstrumentedJit
+  dispatch seam — the late XLA allocation death the admission gate
+  cannot always predict).
+
 None of these are test-only hacks around private invariants: they throw
 real exceptions through real call stacks, which is the point.
 """
@@ -577,6 +587,122 @@ def corrupt_model_file(path: str, mode: str = "truncate_tree") -> str:
     with open(path, "w") as fh:
         fh.write(out)
     return what
+
+
+# ---------------------------------------------------------------------------
+# resource-exhaustion injectors (utils/diskguard.py + utils/resource.py,
+# docs/FAULT_TOLERANCE.md §Resource exhaustion).  The disk injectors
+# install the ONE module-level hook every guarded write passes through,
+# so the injected OSError travels the real call stack of the real sink
+# (events JSONL, compile ledger, quarantine, snapshot tmp, serve state);
+# the OOM injector raises at the InstrumentedJit dispatch seam — exactly
+# where a real XLA RESOURCE_EXHAUSTED surfaces.
+
+
+@contextlib.contextmanager
+def fail_writes(errno_code: int, path_glob: str = "*",
+                armed: bool = True) -> Iterator[dict]:
+    """Every guarded write to a path matching ``path_glob`` raises a
+    real ``OSError(errno_code)`` while ``stats["armed"]`` is True — the
+    full-disk (ENOSPC), quota (EDQUOT), read-only-remount (EROFS) and
+    fd-exhaustion (EMFILE) failures the diskguard layer classifies.
+    Start with ``armed=False`` and flip ``stats["armed"]`` from a
+    training callback to strike mid-run.  Yields stats: ``fired`` /
+    ``paths`` (every injected failure) and the live ``armed`` flag."""
+    import fnmatch
+
+    from ..utils import diskguard
+
+    if diskguard._fault_hook is not None:
+        raise RuntimeError("a diskguard fault hook is already installed")
+    stats = {"fired": 0, "paths": [], "armed": bool(armed)}
+
+    def hook(path: str, nbytes: int) -> None:
+        if stats["armed"] and fnmatch.fnmatch(path, path_glob):
+            stats["fired"] += 1
+            stats["paths"].append(path)
+            raise OSError(int(errno_code), os.strerror(int(errno_code)),
+                          path)
+
+    diskguard._fault_hook = hook
+    try:
+        yield stats
+    finally:
+        diskguard._fault_hook = None
+
+
+@contextlib.contextmanager
+def disk_full_after(n_bytes: int, path_glob: str = "*") -> Iterator[dict]:
+    """The disk accepts ``n_bytes`` more guarded-write traffic (matching
+    ``path_glob``), then every further write raises ENOSPC — the
+    volume-fills-up-mid-run failure, as opposed to ``fail_writes``'s
+    already-full disk.  Yields stats: ``written`` (bytes accepted),
+    ``fired`` (writes refused)."""
+    import errno as _errno
+    import fnmatch
+
+    from ..utils import diskguard
+
+    if diskguard._fault_hook is not None:
+        raise RuntimeError("a diskguard fault hook is already installed")
+    stats = {"written": 0, "fired": 0, "budget": int(n_bytes)}
+
+    def hook(path: str, nbytes: int) -> None:
+        if not fnmatch.fnmatch(path, path_glob):
+            return
+        if stats["written"] + int(nbytes) > stats["budget"]:
+            stats["fired"] += 1
+            raise OSError(_errno.ENOSPC, os.strerror(_errno.ENOSPC), path)
+        stats["written"] += int(nbytes)
+
+    diskguard._fault_hook = hook
+    try:
+        yield stats
+    finally:
+        diskguard._fault_hook = None
+
+
+def make_resource_exhausted(program: str,
+                            nbytes: int = 123456789) -> BaseException:
+    """A device-OOM exception shaped like the real thing: the genuine
+    ``XlaRuntimeError`` class when this jax build exposes it (so
+    ``except``-clause behavior matches production), else a RuntimeError
+    carrying the same RESOURCE_EXHAUSTED text the classifier keys on."""
+    msg = (f"RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+           f"{int(nbytes)} bytes. (injected for program {program!r})")
+    try:
+        from jax._src.lib import xla_client
+        return xla_client.XlaRuntimeError(msg)
+    except Exception:
+        return RuntimeError(msg)
+
+
+@contextlib.contextmanager
+def oom_on_program(program: str, times: int = 1) -> Iterator[dict]:
+    """The next ``times`` dispatches of the jitted program named
+    ``program`` die with RESOURCE_EXHAUSTED at the ``InstrumentedJit``
+    dispatch seam — the late XLA allocation failure the admission gate
+    cannot always predict (fragmentation, a concurrent tenant).  The
+    containment contract under test: the error surfaces as a named
+    ``DeviceOOM`` diagnosis (program, shapes, memwatch snapshot,
+    admission table), never a raw backtrace.  Yields stats with the
+    ``fired`` count."""
+    from ..obs.compile_ledger import InstrumentedJit
+
+    stats = {"fired": 0}
+    orig = InstrumentedJit._dispatch
+
+    def oom_dispatch(self, *args, **kwargs):
+        if self.program == str(program) and stats["fired"] < int(times):
+            stats["fired"] += 1
+            raise make_resource_exhausted(self.program)
+        return orig(self, *args, **kwargs)
+
+    InstrumentedJit._dispatch = oom_dispatch
+    try:
+        yield stats
+    finally:
+        InstrumentedJit._dispatch = orig
 
 
 def flip_byte(path: str, offset: int = -1) -> None:

@@ -81,6 +81,19 @@ def setup(cache_dir: Optional[str] = None,
     cover it (later calls still cover later compiles)."""
     global _configured_dir
     path = resolve_dir(cache_dir)
+    if path is not None:
+        # pre-flight writability (utils/diskguard.py): a full/read-only
+        # cache volume must degrade to "no persistent cache" with one
+        # warning, not surface later as an opaque error from inside
+        # XLA's own cache writer mid-compile
+        from . import diskguard, log
+        if not diskguard.probe_writable(path, sink="compile_cache"):
+            log.warn_once(
+                "compile_cache_unwritable",
+                "compile cache dir %s is not writable; the persistent "
+                "XLA cache is DISABLED for this run (every process pays "
+                "full compiles)", path)
+            path = None
     import jax
     try:
         jax.config.update("jax_compilation_cache_dir", path)

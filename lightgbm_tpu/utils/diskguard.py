@@ -1,0 +1,504 @@
+"""Disk-full-safe write sinks: the observability layer must never be
+the thing that kills the job it observes.
+
+Before this module, a full disk crashed a training run from *inside a
+telemetry writer*: the events JSONL, the compile ledger, the trace
+export, the quarantine sink and the serve state file all called a bare
+``open(..., "w")`` and let ``ENOSPC`` propagate into the boosting loop.
+This module is the single funnel every non-artifact write path routes
+through (enforced by tools/graftcheck's ``resource`` rule family —
+a bare write-mode ``open`` outside this module / ``snapshot.py`` /
+``testing/`` is a finding):
+
+- :func:`classify_oserror` names the resource-exhaustion class of an
+  ``OSError``: ``disk_full`` (ENOSPC), ``quota_exceeded`` (EDQUOT),
+  ``read_only_fs`` (EROFS), ``fd_exhausted`` (EMFILE/ENFILE), and the
+  catch-all ``io_error`` — diagnostics name the class, not just errno.
+- :class:`GuardedWriter` wraps a streaming text sink (events JSONL,
+  quarantine records).  Policy per sink:
+
+  * ``disable`` (telemetry default): the first classified write failure
+    warns ONCE (naming the sink, the path and the class), counts into
+    ``sink_write_errors_total`` / ``sink_write_errors_<sink>``, and the
+    sink *disables itself* — later writes are dropped silently and the
+    run continues;
+  * ``fatal``: the failure raises :class:`SinkWriteError` (a
+    ``LightGBMError``) naming the sink — for outputs whose loss IS the
+    job (the CLI ``task=predict`` stream).
+
+  The process default is ``disable`` and the ``sink_error_policy``
+  config param can flip every policy-unpinned sink — the events
+  stream, the compile ledger, the quarantine sink — to ``fatal``
+  (:func:`set_default_policy`).  Sinks with pinned semantics are not
+  flipped: the trace exporter always disables itself, snapshots and
+  the serve state file keep last-good + retry, artifacts are always
+  fatal.
+- :func:`append_line` is the one-shot append flavor (compile ledger);
+  a sink disabled once stays disabled for the process run
+  (:func:`reset_disabled` re-arms, for tests and fresh runs).
+- :func:`write_file_atomic` is the tmp + fsync + ``os.replace``
+  protocol (snapshots, serve state) with the failure semantics the
+  crash-safety story needs: on ANY write error the orphaned ``.tmp`` is
+  removed and the last-good destination file is left untouched, so the
+  caller can keep serving the previous state and retry on its next
+  interval.
+- :func:`artifact_write` / :func:`write_artifact_atomic` wrap writes
+  whose failure must FAIL the operation: the error is still classified
+  and re-raised as a named :class:`SinkWriteError` instead of a bare
+  ``OSError`` backtrace.  Streaming outputs (the CLI ``task=predict``
+  result) use the context-manager form; whole-file artifacts (model
+  file, binary dataset) use the atomic form so a failed save also
+  keeps the previous good file instead of truncating it in place.
+
+Fault injection (``testing/faults.py``): every guarded write passes
+through one module-level hook (:func:`_maybe_inject`), so
+``fail_writes``/``disk_full_after`` can throw *real* ``OSError`` s
+through the *real* call stacks — the tests prove the recovery paths,
+not mocks of them.
+
+Everything here is host-side by construction: no jax import, zero XLA
+programs (compile-ledger-pinned by tests/test_resource_chaos.py).
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+from typing import Any, Callable, Dict, Optional, Set
+
+from . import log
+from .log import LightGBMError
+
+#: errno -> resource-exhaustion class (the ``sink_write_errors_<class>``
+#: vocabulary lives on the SINK name, not the class; the class lands in
+#: the diagnostic text)
+ERRNO_CLASSES: Dict[int, str] = {
+    errno.ENOSPC: "disk_full",
+    errno.EDQUOT: "quota_exceeded",
+    errno.EROFS: "read_only_fs",
+    errno.EMFILE: "fd_exhausted",
+    errno.ENFILE: "fd_exhausted",
+}
+
+POLICIES = ("disable", "fatal")
+
+# process-wide default for sinks that do not pin a policy; the
+# ``sink_error_policy`` config param sets it per run (engine.train/CLI)
+_default_policy = "disable"
+
+# sinks that hit a classified error under policy=disable stay off for
+# the rest of the process (re-opening a full disk every iteration would
+# turn one incident into a warning flood and an IO busy-loop)
+_disabled_sinks: Set[str] = set()
+
+# fault-injection seam (testing/faults.py fail_writes/disk_full_after):
+# called with (path, nbytes) before every guarded write; raises to
+# inject.  None = no injection.
+_fault_hook: Optional[Callable[[str, int], None]] = None
+
+
+class SinkWriteError(LightGBMError):
+    """A guarded sink's write failed.  Carries the sink name, the path
+    and the classification so callers (the CLI predict stream, tests)
+    can report without re-parsing the message."""
+
+    def __init__(self, sink: str, path: str, classification: str,
+                 cause: BaseException):
+        super().__init__(
+            f"sink {sink!r} ({path}): {classification}: {cause} — "
+            f"see docs/FAULT_TOLERANCE.md §Resource exhaustion")
+        self.sink = str(sink)
+        self.path = str(path)
+        self.classification = str(classification)
+        self.cause = cause
+
+
+def classify_oserror(exc: BaseException) -> str:
+    """Resource-exhaustion class of an ``OSError`` (``io_error`` for
+    anything without a named class — a guarded sink must degrade on
+    those too; an unclassified crash from inside telemetry is exactly
+    the failure mode this layer removes)."""
+    return ERRNO_CLASSES.get(getattr(exc, "errno", None) or -1, "io_error")
+
+
+def set_default_policy(policy: Optional[str]) -> str:
+    """Set the process default sink policy (the ``sink_error_policy``
+    param).  ``None``/empty keeps the current default.  Returns the
+    effective default."""
+    global _default_policy
+    if policy:
+        policy = str(policy)
+        if policy not in POLICIES:
+            raise LightGBMError(
+                f"Unknown sink_error_policy {policy!r} "
+                f"(expected one of {', '.join(POLICIES)})")
+        _default_policy = policy
+    return _default_policy
+
+
+def default_policy() -> str:
+    return _default_policy
+
+
+def disabled_sinks() -> Set[str]:
+    """Sinks currently disabled by a classified write error (copy)."""
+    return set(_disabled_sinks)
+
+
+def reset_disabled() -> None:
+    """Re-arm every disabled sink (tests; a fresh run on a fresh disk).
+    The per-sink warn-once keys are re-armed too: a re-armed sink's
+    next incident must be NAMED in a warning again, not just counted —
+    the 'every disabled sink named' contract holds per re-arm, not
+    once per process."""
+    _disabled_sinks.clear()
+    log.reset_warn_once("sink_write_")
+
+
+def _maybe_inject(path: str, nbytes: int) -> None:
+    hook = _fault_hook
+    if hook is not None:
+        hook(str(path), int(nbytes))
+
+
+def _note_sink_error(sink: str, path: str, exc: BaseException,
+                     action: str = "the sink is disabled for the rest "
+                     "of this run — the job it observes continues"
+                     ) -> str:
+    """Count + warn one classified sink write failure; returns the
+    classification.  Shared by every policy so the
+    ``sink_write_errors_*`` counters are the chaos suite's ground truth
+    regardless of what happens next (disable / fatal / retry)."""
+    from .. import obs
+    cls = classify_oserror(exc)
+    obs.inc("sink_write_errors_total")
+    obs.inc("sink_write_errors_" + str(sink))
+    log.warn_once(
+        f"sink_write_{sink}",
+        "sink %r (%s) hit %s (%s); %s (docs/FAULT_TOLERANCE.md "
+        "§Resource exhaustion)", sink, path, cls, exc, action)
+    return cls
+
+
+#: public alias: callers owning their own retry/degrade semantics (the
+#: snapshot layer, the serve state file) still count and warn through
+#: the one funnel
+note_sink_error = _note_sink_error
+
+
+class GuardedWriter:
+    """Streaming text sink with classified-failure containment.
+
+    Line-buffered by default so committed records survive a crash
+    without an explicit flush; ``flush()`` is still honored for sinks
+    with a flush cadence (``events_flush_every``).  ``write()`` returns
+    True when the text reached the OS, False when the sink is disabled
+    (policy ``disable`` after a failure) — callers that track a written
+    count (``EventRecorder.events_written``) count the Trues.
+    """
+
+    def __init__(self, path: str, sink: str,
+                 policy: Optional[str] = None, mode: str = "w",
+                 buffering: int = 1):
+        self.path = str(path)
+        self.sink = str(sink)
+        self.policy = policy or _default_policy
+        if self.policy not in POLICIES:
+            raise LightGBMError(
+                f"Unknown sink policy {self.policy!r} for sink "
+                f"{self.sink!r} (expected one of {', '.join(POLICIES)})")
+        self._mode = mode
+        self._buffering = buffering
+        self._fh: Optional[Any] = None
+        self._closed = False
+        self._opened = False
+
+    # -- state ----------------------------------------------------------
+    @property
+    def disabled(self) -> bool:
+        return self.sink in _disabled_sinks
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # -- failure funnel --------------------------------------------------
+    def _fail(self, exc: BaseException) -> bool:
+        cls = _note_sink_error(self.sink, self.path, exc)
+        _disabled_sinks.add(self.sink)
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+        if self.policy == "fatal":
+            raise SinkWriteError(self.sink, self.path, cls, exc) from exc
+        return False
+
+    def _ensure_open(self) -> bool:
+        if self._fh is not None:
+            return True
+        if self._closed or self.disabled:
+            return False
+        try:
+            _maybe_inject(self.path, 0)
+            directory = os.path.dirname(self.path)
+            if directory:
+                os.makedirs(directory, exist_ok=True)
+            self._fh = open(self.path, self._mode,
+                            buffering=self._buffering)
+            self._opened = True
+            return True
+        except OSError as exc:
+            return self._fail(exc)
+
+    # -- the sink API ----------------------------------------------------
+    def touch(self) -> bool:
+        """Eagerly create/truncate the file (streams whose consumers
+        expect the file to exist even before the first record)."""
+        return self._ensure_open()
+
+    def write(self, text: str) -> bool:
+        if not self._ensure_open():
+            return False
+        try:
+            _maybe_inject(self.path, len(text))
+            self._fh.write(text)
+            return True
+        except OSError as exc:
+            return self._fail(exc)
+
+    def flush(self) -> bool:
+        if self._fh is None:
+            return False
+        try:
+            _maybe_inject(self.path, 0)
+            self._fh.flush()
+            return True
+        except OSError as exc:
+            return self._fail(exc)
+
+    def close(self) -> None:
+        self._closed = True
+        if self._fh is None:
+            return
+        try:
+            self._fh.close()
+        except OSError as exc:
+            self._fh = None
+            try:
+                self._fail(exc)
+            except SinkWriteError:
+                raise
+            return
+        self._fh = None
+
+    def __enter__(self) -> "GuardedWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def append_line(path: str, text: str, sink: str,
+                policy: Optional[str] = None) -> bool:
+    """Append one line to ``path`` under guarded semantics (the compile
+    ledger's one-line-per-event shape: open, write, close — each event
+    is durable the moment ``record`` returns).  Returns False when the
+    sink is disabled or the write failed under policy ``disable``."""
+    policy = policy or _default_policy
+    sink = str(sink)
+    if sink in _disabled_sinks:
+        return False
+    try:
+        _maybe_inject(str(path), len(text) + 1)
+        with open(path, "a") as fh:
+            fh.write(text + "\n")
+        return True
+    except OSError as exc:
+        cls = _note_sink_error(sink, str(path), exc)
+        _disabled_sinks.add(sink)
+        if policy == "fatal":
+            raise SinkWriteError(sink, str(path), cls, exc) from exc
+        return False
+
+
+def write_text(path: str, text: str, sink: str) -> str:
+    """Whole-file text write that raises a classified
+    :class:`SinkWriteError` on failure (callers own the policy — the
+    trace exporter catches it to disable itself, artifact savers let it
+    surface as the operation's named error)."""
+    try:
+        _maybe_inject(str(path), len(text))
+        with open(path, "w") as fh:
+            fh.write(text)
+        return str(path)
+    except OSError as exc:
+        cls = _note_sink_error(sink, str(path), exc,
+                               action="the write is abandoned")
+        raise SinkWriteError(sink, str(path), cls, exc) from exc
+
+
+def write_file_atomic(path: str, blob: bytes, sink: str,
+                      fsync: bool = True) -> str:
+    """The tmp + fsync + ``os.replace`` protocol with last-good
+    semantics: on ANY failure the orphaned ``.tmp`` is removed and the
+    destination file is left exactly as it was, so a reader always sees
+    either the previous good file or the new one — never a torn write,
+    never an accumulating ``.tmp`` per retry.  Raises the original
+    ``OSError`` (callers classify via :func:`classify_oserror`; the
+    snapshot layer turns it into warn + retry-next-interval)."""
+    path = str(path)
+    directory = os.path.dirname(path)
+    if directory:
+        os.makedirs(directory, exist_ok=True)
+    tmp = path + ".tmp"
+    try:
+        _maybe_inject(tmp, len(blob))
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+            fh.flush()
+            if fsync:
+                os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except OSError:
+        # keep the last-good destination; never leave the torn tmp
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def write_artifact_atomic(path: str, blob: bytes, sink: str) -> str:
+    """Atomic whole-file ARTIFACT write (the model file's one-string
+    save): tmp + ``os.replace`` with artifact failure semantics — a
+    classified, named :class:`SinkWriteError` instead of a bare
+    ``OSError``.  The last-good destination survives any failure: an
+    ENOSPC halfway through ``save_model_to_file`` must not destroy the
+    previous good model by truncating it in place.  Streaming
+    producers (``np.savez`` archives) use
+    ``artifact_write(..., atomic=True)`` directly instead of staging
+    the whole blob in host memory."""
+    with artifact_write(path, sink, mode="wb", atomic=True) as fh:
+        fh.write(blob)
+    return str(path)
+
+
+class _ArtifactHandle:
+    """File proxy for :func:`artifact_write`: every write passes the
+    fault-injection seam so the chaos suite covers artifact paths too."""
+
+    def __init__(self, fh, path: str):
+        self._fh = fh
+        self._path = path
+
+    def write(self, data) -> int:
+        _maybe_inject(self._path, len(data))
+        return self._fh.write(data)
+
+    def __getattr__(self, name: str):
+        # seek/tell/fileno/flush pass through (np.savez writes a zip
+        # archive and needs the full file protocol)
+        return getattr(self._fh, name)
+
+
+class artifact_write:
+    """Context manager for STREAMING artifact writes: a write failure
+    must fail the operation — but as a named, classified
+    :class:`SinkWriteError` (counted into ``sink_write_errors_*`` like
+    every other guarded failure), not a bare ``OSError`` backtrace.
+    ``atomic=False`` writes the destination in place (the CLI predict
+    output — an append-as-you-go stream whose partial rows are part of
+    the diagnosis); ``atomic=True`` streams into ``<path>.tmp`` and
+    ``os.replace`` s on clean exit, so a failed save keeps the previous
+    good file (model file, binary dataset).  Usage::
+
+        with diskguard.artifact_write(path, "predict_output") as fh:
+            fh.write(text)
+    """
+
+    def __init__(self, path: str, sink: str, mode: str = "w",
+                 atomic: bool = False):
+        self.path = str(path)
+        self.sink = str(sink)
+        self.mode = mode
+        self.atomic = bool(atomic)
+        self._target = self.path + ".tmp" if atomic else self.path
+        self._fh = None
+
+    def _raise(self, exc: OSError) -> None:
+        if self.atomic:
+            # keep the last-good destination; never leave the torn tmp
+            try:
+                os.unlink(self._target)
+            except OSError:
+                pass
+        cls = _note_sink_error(
+            self.sink, self.path, exc,
+            action="the write is abandoned" +
+                   ("; the previous file is kept" if self.atomic else
+                    " — the operation fails with a named error"))
+        raise SinkWriteError(self.sink, self.path, cls, exc) from exc
+
+    def __enter__(self) -> _ArtifactHandle:
+        try:
+            _maybe_inject(self._target, 0)
+            directory = os.path.dirname(self.path)
+            if directory:
+                os.makedirs(directory, exist_ok=True)
+            self._fh = open(self._target, self.mode)
+        except OSError as exc:
+            self._raise(exc)
+        return _ArtifactHandle(self._fh, self._target)
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._fh is None:
+            return
+        try:
+            self._fh.close()
+        except OSError as cexc:
+            if exc_type is None:
+                self._raise(cexc)
+            # the with-body's own error wins (wrapped below if OSError):
+            # a buffered-flush failure at close usually shares the
+            # body's root cause, and two errors must not hide the first
+        finally:
+            self._fh = None
+        if exc_type is not None:
+            if isinstance(exc, OSError):
+                self._raise(exc)
+            if self.atomic:
+                # non-OSError body failure (a serializer bug): still
+                # sweep the torn tmp, let the original error propagate
+                try:
+                    os.unlink(self._target)
+                except OSError:
+                    pass
+            return
+        if self.atomic:
+            try:
+                os.replace(self._target, self.path)
+            except OSError as rexc:
+                self._raise(rexc)
+
+
+def probe_writable(directory: str, sink: str) -> bool:
+    """Best-effort writability probe of ``directory`` (the compile
+    cache pre-flight): True when a probe file can be created and
+    removed.  Classified failures warn once and return False — the
+    caller degrades (disables the cache) instead of letting a full disk
+    surface later as an opaque error from inside XLA's cache writer."""
+    probe = os.path.join(str(directory), ".lgbt_write_probe")
+    try:
+        os.makedirs(str(directory), exist_ok=True)
+        _maybe_inject(probe, 1)
+        with open(probe, "w") as fh:
+            fh.write("x")
+        os.unlink(probe)
+        return True
+    except OSError as exc:
+        _note_sink_error(sink, str(directory), exc)
+        return False

@@ -76,9 +76,16 @@ def warn_once(key: str, msg: str, *args) -> None:
     warning(msg, *args)
 
 
-def reset_warn_once() -> None:
-    """Forget warn_once history (tests / long-lived embedders)."""
-    _warned_once.clear()
+def reset_warn_once(prefix: str = "") -> None:
+    """Forget warn_once history (tests / long-lived embedders).  With a
+    ``prefix``, only keys starting with it are re-armed (diskguard's
+    ``reset_disabled`` re-arms the per-sink warnings so a re-armed
+    sink's NEXT incident is named again, not just counted)."""
+    if prefix:
+        for key in [k for k in _warned_once if k.startswith(prefix)]:
+            _warned_once.discard(key)
+    else:
+        _warned_once.clear()
 
 
 class LightGBMError(Exception):

@@ -1,0 +1,213 @@
+"""Resource-exhaustion containment: HBM admission control and
+device-OOM diagnosis (docs/FAULT_TOLERANCE.md §Resource exhaustion).
+
+Two halves, both host-side by construction (no new XLA programs —
+compile-ledger-pinned by tests/test_resource_chaos.py):
+
+**Admission control** (:func:`admit`): ``models/gbdt.py`` hands the gate
+its per-component HBM estimate (``estimate_train_memory``) and the
+device budget; under ``memory_policy=fail_fast`` an over-budget config
+refuses up front with a :class:`MemoryBudgetExceeded` carrying the
+per-component table, and under ``memory_policy=degrade`` the booster
+walks a documented ladder of footprint reductions — each step applied
+with one ``warn_once`` and a ``resource_degrade_total`` /
+``resource_degrade_<step>`` counter — refusing only when the ladder
+bottoms out still over budget.  The ladder itself lives in gbdt.py
+(the steps mutate booster construction state); this module owns the
+accounting, the table rendering and the refusal.
+
+**OOM diagnosis** (:func:`reraise_if_oom`): ``obs.InstrumentedJit`` is
+the single dispatch choke point for every jitted program in the repo,
+and it routes any ``RESOURCE_EXHAUSTED`` escaping XLA through here: the
+opaque allocator backtrace becomes a :class:`DeviceOOM` (a
+``LightGBMError``) naming the PROGRAM that allocated, the abstract
+shapes of the call that triggered it, a memwatch snapshot of what the
+device held, and the last admission table (:func:`set_budget_table` —
+what the gate *predicted*).  On TPU an OOM must read like a diagnosis,
+not a backtrace.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import log
+from .log import LightGBMError
+
+#: the degrade ladder's step names, in application order (documented in
+#: docs/FAULT_TOLERANCE.md §Resource exhaustion — the order is part of
+#: the contract: cheapest/least-lossy reduction first)
+DEGRADE_STEPS = ("score_donation", "hist_cache", "row_pad")
+
+MEMORY_POLICIES = ("fail_fast", "degrade")
+
+# last admission table published by a memory gate (models/gbdt.py):
+# the OOM diagnosis folds it in so "what the gate predicted" sits next
+# to "what the allocator saw"
+_budget_table: Optional[Dict[str, int]] = None
+_budget_context: str = ""
+
+
+class MemoryBudgetExceeded(LightGBMError):
+    """The admission gate refused a configuration: the estimated device
+    footprint exceeds the budget (after the degrade ladder, under
+    ``memory_policy=degrade``).  The message carries the per-component
+    table; ``estimate`` / ``limit`` / ``steps_taken`` are machine-
+    readable for tests and tooling."""
+
+    def __init__(self, msg: str, estimate: Dict[str, int], limit: int,
+                 steps_taken: Tuple[str, ...] = ()):
+        super().__init__(msg)
+        self.estimate = dict(estimate)
+        self.limit = int(limit)
+        self.steps_taken = tuple(steps_taken)
+
+
+class DeviceOOM(LightGBMError):
+    """A jitted program died in XLA allocation (``RESOURCE_EXHAUSTED``).
+    Raised by :func:`reraise_if_oom` with the program name, the abstract
+    shapes of the triggering call, a memwatch snapshot and the last
+    admission table — the diagnosis the raw backtrace never gives."""
+
+    def __init__(self, msg: str, program: str, shapes: str):
+        super().__init__(msg)
+        self.program = str(program)
+        self.shapes = str(shapes)
+
+
+def format_table(est: Dict[str, int]) -> str:
+    """Render a per-component byte table as one diagnostic line:
+    ``bins_device=12MB, histogram_cache=340MB, ... (total=400MB)``."""
+    parts = [f"{k}={v / (1 << 20):.1f}MB" for k, v in est.items()
+             if k != "total"]
+    return (", ".join(parts)
+            + f" (total={est.get('total', 0) / (1 << 20):.1f}MB)")
+
+
+def set_budget_table(est: Optional[Dict[str, int]],
+                     context: str = "") -> None:
+    """Publish the most recent admission estimate so an OOM diagnosis
+    can show what the gate predicted.  ``None`` clears it."""
+    global _budget_table, _budget_context
+    _budget_table = dict(est) if est else None
+    _budget_context = str(context)
+
+
+def budget_table() -> Optional[Dict[str, int]]:
+    return dict(_budget_table) if _budget_table else None
+
+
+def check_memory_policy(policy: str) -> str:
+    policy = str(policy or "fail_fast")
+    if policy not in MEMORY_POLICIES:
+        raise LightGBMError(
+            f"Unknown memory_policy {policy!r} "
+            f"(expected one of {', '.join(MEMORY_POLICIES)})")
+    return policy
+
+
+def note_degrade(step: str, saved_bytes: int, detail: str) -> None:
+    """Account one applied degrade-ladder step: warn ONCE per step per
+    process and bump the ``resource_degrade_total`` /
+    ``resource_degrade_<step>`` counters."""
+    from .. import obs
+    if step not in DEGRADE_STEPS:
+        raise ValueError(f"unknown degrade step {step!r}")
+    obs.inc("resource_degrade_total")
+    obs.inc("resource_degrade_" + step)
+    log.warn_once(
+        f"resource_degrade_{step}",
+        "memory_policy=degrade: %s (saves ~%.1fMB). %s",
+        step, saved_bytes / (1 << 20), detail)
+
+
+def refuse(est: Dict[str, int], limit: int, what: str,
+           steps_taken: Tuple[str, ...] = ()) -> "MemoryBudgetExceeded":
+    """Build (and return — caller raises) the named admission refusal
+    with the per-component table."""
+    tried = (f"  Degrade ladder already applied: "
+             f"{', '.join(steps_taken)}." if steps_taken else "")
+    return MemoryBudgetExceeded(
+        f"estimated {what} memory {est['total'] / (1 << 20):.0f}MB "
+        f"exceeds the device budget {limit / (1 << 20):.0f}MB "
+        f"({format_table(est)}).{tried}  The dense-only design has no "
+        f"sparse spill (SURVEY §7.2): shrink num_leaves/max_bin or "
+        f"train on fewer rows (memory_policy=degrade walks the "
+        f"footprint-reduction ladder first; docs/FAULT_TOLERANCE.md "
+        f"§Resource exhaustion).",
+        est, limit, steps_taken)
+
+
+# ---------------------------------------------------------------------------
+# device-OOM classification + diagnosis (the InstrumentedJit boundary)
+
+#: substrings that mark an exception as an XLA allocation failure.
+#: XLA raises XlaRuntimeError("RESOURCE_EXHAUSTED: Out of memory ...");
+#: some backends spell it "Resource exhausted".
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Resource exhausted",
+                "Out of memory", "out of memory")
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """True when ``exc`` is an XLA/device allocation failure.  String
+    classification is deliberate: the concrete exception class moved
+    across jax releases (``XlaRuntimeError`` lives in different modules)
+    and an errno-style code is not exposed."""
+    if isinstance(exc, MemoryError):
+        return True
+    text = str(exc)
+    return any(m in text for m in _OOM_MARKERS) and not isinstance(
+        exc, (KeyboardInterrupt, SystemExit))
+
+
+def _memwatch_snapshot() -> str:
+    """One-line device/host residency snapshot for the OOM diagnosis.
+    Best-effort: a diagnosis path must never raise its own error."""
+    try:
+        from ..obs import memwatch
+        s = memwatch.sample()
+    except Exception:
+        return "memwatch unavailable"
+    parts: List[str] = []
+    if s.get("live_bytes", -1) >= 0:
+        parts.append(f"live_arrays={s['live_arrays']} "
+                     f"live_bytes={s['live_bytes'] / (1 << 20):.1f}MB")
+    if "device_bytes_in_use" in s:
+        parts.append("device_in_use="
+                     f"{s['device_bytes_in_use'] / (1 << 20):.1f}MB")
+    if "device_peak_bytes" in s:
+        parts.append("device_peak="
+                     f"{s['device_peak_bytes'] / (1 << 20):.1f}MB")
+    return " ".join(parts) or "memwatch saw no device stats"
+
+
+def reraise_if_oom(exc: BaseException, program: str, shapes: str) -> None:
+    """Called from ``obs.InstrumentedJit`` when a dispatch raised: if
+    the failure is a device allocation failure, re-raise it as a
+    :class:`DeviceOOM` naming the program, its abstract shapes, a
+    memwatch snapshot and the last admission table.  Anything else
+    returns (the caller re-raises the original)."""
+    if not is_resource_exhausted(exc):
+        return
+    from .. import obs
+    obs.inc("device_oom_total")
+    obs.inc("device_oom_" + _sanitize(program))
+    table = ("admission estimate: " + format_table(_budget_table)
+             + (f" [{_budget_context}]" if _budget_context else "")
+             if _budget_table else
+             "admission estimate: none published (prediction-only or "
+             "pre-gate allocation)")
+    first = str(exc).splitlines()[0][:300]
+    raise DeviceOOM(
+        f"device out of memory while dispatching program "
+        f"{program!r} over shapes [{shapes}].  {table}.  "
+        f"memwatch: {_memwatch_snapshot()}.  XLA said: {first}.  "
+        f"Shrink num_leaves/max_bin/rows, or set memory_policy=degrade "
+        f"to let the admission gate walk the footprint ladder "
+        f"(docs/FAULT_TOLERANCE.md §Resource exhaustion).",
+        program, shapes) from exc
+
+
+def _sanitize(name: str) -> str:
+    from ..obs import phases
+    return phases.sanitize(name)

@@ -582,6 +582,82 @@ def test_ingress_scoped_to_io_only(tmp_path):
     assert report.findings == [], report.findings
 
 
+# -- family: resource ----------------------------------------------------
+
+def test_resource_raw_open_trips_on_write_modes(tmp_path):
+    root = _tree(tmp_path, {"obs/sink.py": """
+        import json
+
+        def dump(path, events, extra):
+            with open(path, "w") as fh:
+                json.dump(events, fh)
+            fh2 = open(path + ".log", mode="a")
+            fh2.write(extra)
+            fh2.close()
+            with open(path + ".bin", "wb") as fh3:
+                fh3.write(b"x")
+    """})
+    report = run_checks(root, families=["resource"])
+    raw = [f for f in report.findings if f.rule == "resource-raw-open"]
+    assert len(raw) == 3, report.findings
+    assert all("diskguard" in f.message for f in raw)
+
+
+def test_resource_raw_open_ignores_reads_and_funnel_modules(tmp_path):
+    root = _tree(tmp_path, {
+        "obs/reader.py": """
+            def load(path):
+                with open(path) as fh:
+                    return fh.read()
+
+            def load_bytes(path):
+                with open(path, "rb") as fh:
+                    return fh.read()
+        """,
+        # the funnel itself and the atomic-protocol owner are exempt
+        "utils/diskguard.py": """
+            def guarded(path, text):
+                with open(path, "w") as fh:
+                    fh.write(text)
+        """,
+        "snapshot.py": """
+            def write(path, blob):
+                with open(path + ".tmp", "wb") as fh:
+                    fh.write(blob)
+        """,
+        # fault injectors corrupt files on purpose
+        "testing/faults.py": """
+            def corrupt(path):
+                with open(path, "r+b") as fh:
+                    fh.write(b"x")
+        """,
+    })
+    report = run_checks(root, families=["resource"])
+    assert report.findings == [], report.findings
+
+
+def test_resource_raw_open_suppression_counts(tmp_path):
+    root = _tree(tmp_path, {"io/export.py": """
+        def export(path, text):
+            with open(path, "w") as fh:  # graftcheck: disable=resource-raw-open
+                fh.write(text)
+    """})
+    report = run_checks(root, families=["resource"])
+    assert report.findings == []
+    assert [f.rule for f in report.suppressed] == ["resource-raw-open"]
+
+
+def test_resource_skips_unjudgeable_modes(tmp_path):
+    # a non-constant mode expression is not judged (zero-false-positive
+    # bias, same stance as the ingress taint tracking)
+    root = _tree(tmp_path, {"io/any.py": """
+        def reopen(path, mode):
+            return open(path, mode)
+    """})
+    report = run_checks(root, families=["resource"])
+    assert report.findings == [], report.findings
+
+
 # -- the repo itself -----------------------------------------------------
 
 def test_repo_is_clean():

@@ -186,6 +186,14 @@ def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
         bad = obj.get("bad_rows")
         if isinstance(bad, dict) and bad:
             verdict[f"bad_rows_{side}"] = bad
+        # PR 15: resource bill (docs/FAULT_TOLERANCE.md §Resource
+        # exhaustion) — estimated vs measured peak bytes, degrade-ladder
+        # steps taken, sink write errors.  Informational: degrade steps
+        # are budget-dependent, never gated, never required (old
+        # baselines keep comparing)
+        res = obj.get("resource")
+        if isinstance(res, dict) and res:
+            verdict[f"resource_{side}"] = res
         # PR 14: wide-sparse training bill (docs/SPARSE.md) — EFB bundle
         # shrinkage, screening's active-feature trajectory, and the run's
         # AUC ride along informationally so an A/B ctrlike comparison

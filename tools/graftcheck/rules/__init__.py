@@ -18,8 +18,10 @@ lifecycle  thread-lifecycle   threads daemonized or joined
            wall-clock         monotonic clocks on deadline math
 phases     phase-taxonomy     host/device phase taxonomy in sync
 params     param-docs         config params documented + rendered
+resource   resource-raw-open  write-mode open() routes through
+                              utils/diskguard.py (disk-full-safe sinks)
 ========== ================== ==========================================
 """
 
 from . import (ingress, jit, lifecycle, locks, params,  # noqa: F401
-               phases, tracer)
+               phases, resource, tracer)

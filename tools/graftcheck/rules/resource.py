@@ -1,0 +1,91 @@
+"""Resource-exhaustion containment rules (family ``resource``).
+
+PR 15's diskguard layer (``lightgbm_tpu/utils/diskguard.py``,
+docs/FAULT_TOLERANCE.md §Resource exhaustion) only holds if every write
+path actually routes through it: one forgotten bare ``open(..., "w")``
+in a future telemetry sink re-creates the failure class the layer
+removed — a full disk crashing a training run from inside an observer.
+
+``resource-raw-open`` — a write-capable ``open()`` (mode containing
+``w``/``a``/``x``/``+``) anywhere in the package outside the funnel
+modules is a finding.  Exempt:
+
+- ``utils/diskguard.py`` — it IS the funnel;
+- ``snapshot.py`` — owns the atomic tmp+fsync+replace protocol and
+  routes its data writes through ``diskguard.write_file_atomic``
+  already (its read-modify helpers hold the exemption);
+- everything under ``testing/`` — the fault injectors corrupt files on
+  purpose, with raw opens, which is their job.
+
+Telemetry/state sinks must use ``diskguard.GuardedWriter`` /
+``append_line`` / ``write_file_atomic`` (classified failures degrade);
+artifact writes (model files, binary datasets, prediction output) must
+use ``diskguard.artifact_write`` (classified failures are NAMED
+fatals).  Like every family, suppressions (``# graftcheck:
+disable=resource-raw-open``) are visible and counted, never silent.
+
+The check is purely syntactic (an ``ast`` walk for ``open`` calls with
+a constant write mode) — a non-constant mode expression is not judged,
+matching the suite's zero-false-positive bias.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Finding, Project, family
+
+#: modules allowed to call write-mode open() directly
+_EXEMPT_FILES = ("utils/diskguard.py", "snapshot.py")
+_EXEMPT_DIRS = ("testing/",)
+
+_WRITE_CHARS = set("wax+")
+
+
+def _open_write_mode(node: ast.Call) -> Optional[str]:
+    """The constant mode string of an ``open()`` call when it is
+    write-capable, else None (read mode, or a mode the walk cannot
+    evaluate)."""
+    if not (isinstance(node.func, ast.Name) and node.func.id == "open"):
+        return None
+    mode_node: Optional[ast.AST] = None
+    if len(node.args) >= 2:
+        mode_node = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if not (isinstance(mode_node, ast.Constant)
+            and isinstance(mode_node.value, str)):
+        return None
+    mode = mode_node.value
+    return mode if (_WRITE_CHARS & set(mode)) else None
+
+
+@family("resource")
+def check_resource(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    pkg_prefix = f"{project.pkg_rel}/"
+    for mod in project.modules:
+        rel_in_pkg = mod.rel[len(pkg_prefix):] \
+            if mod.rel.startswith(pkg_prefix) else mod.rel
+        # exact relative paths, not endswith: a future
+        # serve/state_snapshot.py must NOT inherit snapshot.py's waiver
+        if rel_in_pkg in _EXEMPT_FILES:
+            continue
+        if any(rel_in_pkg.startswith(d) for d in _EXEMPT_DIRS):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            mode = _open_write_mode(node)
+            if mode is None:
+                continue
+            findings.append(Finding(
+                "resource-raw-open", mod.rel, node.lineno,
+                f"bare open(..., {mode!r}) — route writes through "
+                f"utils/diskguard.py (GuardedWriter/append_line/"
+                f"write_file_atomic for sinks, artifact_write for "
+                f"artifacts) so a full disk is a classified, contained "
+                f"event instead of a crash from inside a writer"))
+    return findings
