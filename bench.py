@@ -79,6 +79,37 @@ def make_ctr_like(num_data: int, num_features: int = 2000,
     return X, y
 
 
+def _profile_blocks():
+    """The BENCH ``profile`` + ``device`` blocks (obs/devprof.py,
+    obs/devcaps.py).  Always emitted: ``profile.mode`` records whether
+    device-time attribution ran (arm it with LIGHTGBM_TPU_DEVPROF), and
+    ``device`` makes every archived BENCH_r*.json self-describing about
+    the hardware and peak numbers that produced it."""
+    import jax
+    from lightgbm_tpu.obs import report
+    prof = report.profile_summary()
+    caps = prof["device"]
+    profile = {
+        "mode": prof["mode"],
+        "rounds": prof["rounds"],
+        "device_seconds_est_total": prof["device_seconds_est_total"],
+        "samples_total": prof["samples_total"],
+        "dispatches_total": prof["dispatches_total"],
+        "programs": prof["programs"],
+        "transfers": prof["transfers"],
+    }
+    device = {
+        "platform": caps.get("platform"),
+        "device_kind": caps.get("device_kind"),
+        "device_count": jax.device_count(),
+        "peak_flops": caps.get("peak_flops"),
+        "peak_bytes_per_sec": caps.get("peak_bytes_per_sec"),
+        "peaks_source": caps.get("source"),
+        "jax_version": jax.__version__,
+    }
+    return profile, device
+
+
 def _fleet_scaling(booster, X32: np.ndarray, concurrency: int) -> dict:
     """``--concurrency N``: threaded closed-loop clients against the
     serving fleet at every replica count 1..len(local_devices) — the
@@ -176,6 +207,10 @@ def predict_main(concurrency: int = 0) -> None:
     from lightgbm_tpu.models.gbdt import GBDT
     from lightgbm_tpu.serve.forest import CompiledForest
     from lightgbm_tpu import obs
+    # bench drives GBDT directly (no engine.train), so arm device-time
+    # attribution here: LIGHTGBM_TPU_DEVPROF=sample:N|full populates the
+    # BENCH `profile` block; unset leaves it off (zero overhead)
+    obs.devprof.configure(None)
 
     X, y = make_higgs_like(rows)
     cfg = Config({"objective": "binary", "metric": "auc",
@@ -238,6 +273,7 @@ def predict_main(concurrency: int = 0) -> None:
         "warmup_s": round(t_warm, 3),
         "compile_events": compile_ledger.summary(5),
     }
+    result["profile"], result["device"] = _profile_blocks()
     if fleet is not None:
         result["concurrency"] = concurrency
         result["fleet"] = fleet
@@ -278,6 +314,11 @@ def main(dataset: str = "higgslike") -> None:
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.io.dataset import BinnedDataset
     from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu import obs as _obs_p
+    # bench drives GBDT directly (no engine.train), so arm device-time
+    # attribution here: LIGHTGBM_TPU_DEVPROF=sample:N|full populates the
+    # BENCH `profile` block; unset leaves it off (zero overhead)
+    _obs_p.devprof.configure(None)
 
     params = {"objective": "binary", "metric": "auc",
               "num_leaves": 63, "max_bin": 255, "learning_rate": 0.1,
@@ -376,6 +417,7 @@ def main(dataset: str = "higgslike") -> None:
         "spread": [round(min(rates), 4), round(max(rates), 4)],
         "compile_events": compile_ledger.summary(5),
     }
+    bench_json["profile"], bench_json["device"] = _profile_blocks()
     if auc is not None:
         bench_json["auc"] = round(float(auc), 5)
     if dataset == "ctrlike":

@@ -97,6 +97,12 @@ DESC = {
     "memwatch": "sample HBM watermark gauges (live/peak device bytes, "
                 "per phase) at span boundaries; off by default, "
                 "LIGHTGBM_TPU_MEMWATCH env wins",
+    "devprof": "device-time attribution: off | full | sample:N forces a "
+               "sync on every Nth dispatch per XLA program and records "
+               "per-program device seconds, roofline gauges, and the "
+               "per-round host/device split; off by default (zero "
+               "overhead), LIGHTGBM_TPU_DEVPROF env wins "
+               "(docs/OBSERVABILITY.md)",
     "trace_events_file": "Chrome trace-event JSON export of the causal "
                          "span tree (one trace per serve request / "
                          "boosting round; load in Perfetto); "

@@ -272,6 +272,10 @@ _DEFAULTS: Dict[str, Any] = {
                                 # (LIGHTGBM_TPU_COMPILE_LEDGER env wins)
     "memwatch": False,          # HBM watermark gauges at span boundaries
                                 # (LIGHTGBM_TPU_MEMWATCH env wins)
+    "devprof": "off",           # device-time attribution: off | full |
+                                # sample:N forces+times a device sync every
+                                # Nth dispatch per program
+                                # (LIGHTGBM_TPU_DEVPROF env wins)
     "trace_events_file": "",    # Chrome trace-event JSON export of the
                                 # causal span tree (LIGHTGBM_TPU_TRACE_EVENTS
                                 # env wins; load in Perfetto)
@@ -512,6 +516,10 @@ class Config:
         if v["serve_latency_outlier"] <= 1.0:
             raise ValueError("serve_latency_outlier must be > 1 — it "
                              "multiplies the fleet-median service time")
+        # devprof mode grammar is owned by obs/devprof.parse_mode — a
+        # typo'd value must die here, not silently disable profiling
+        from .obs.devprof import parse_mode as _devprof_parse
+        _devprof_parse(v["devprof"])
         # num_machines here means mesh devices; 1 device => normalize back to
         # serial like the reference (config.cpp:161-172).
         if v["num_machines"] <= 1:

@@ -61,9 +61,11 @@ def train(params, train_set, num_boost_round=100,
     # compile ledger / HBM watermarks / causal trace export.  All off
     # unless configured; the matching env vars win inside configure().
     from .obs import compile_ledger as _compile_ledger
+    from .obs import devprof as _devprof
     from .obs import memwatch as _memwatch
     from .obs import tracing as _tracing
     _compile_ledger.configure(params.get("compile_ledger_file") or None)
+    _devprof.configure(params.get("devprof"))
     _memwatch.configure(params.get("memwatch"))
     _tracing.TRACER.configure(params.get("trace_events_file") or None)
     # -- disk-full-safe sinks (utils/diskguard.py): each run's policy is

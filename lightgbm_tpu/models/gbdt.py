@@ -208,8 +208,9 @@ class _DeviceData:
                 dataset.metadata.init_score,
                 np.float32).reshape(num_models, self.num_data)
         self.score = jnp.asarray(init)
-        obs.inc("host_to_device_transfers", h2d_xfers + 1)
-        obs.inc("host_to_device_bytes", h2d_bytes + int(init.nbytes))
+        obs.devprof.transfer("h2d", "dataset",
+                             h2d_bytes + int(init.nbytes),
+                             transfers=h2d_xfers + 1)
 
     def host_score(self, dtype=np.float64) -> np.ndarray:
         """[num_models, num_data] host copy of the score cache with the
@@ -1335,9 +1336,9 @@ class GBDT:
         pend_idx, self._pending_iter_idx = self._pending_iter_idx, -1
         with timetag.scope("GBDT::host_tree"):
             host = jax.device_get([packed for packed, _, _ in pend])
-        obs.inc("device_to_host_transfers")
-        obs.inc("device_to_host_bytes",
-                sum(int(iv.nbytes) + int(fv.nbytes) for iv, fv in host))
+        obs.devprof.transfer(
+            "d2h", "host_tree",
+            sum(int(iv.nbytes) + int(fv.nbytes) for iv, fv in host))
         L = self.grow_params.num_leaves
         trees = [Tree.from_arrays(unpack_tree_arrays(iv, fv, L),
                                   self.train_set.mappers,
@@ -1429,7 +1430,10 @@ class GBDT:
                 "round's custom-objective gradients were computed from "
                 "the pre-resync scores; the pod stays consistent but "
                 "this one round ingests the stale gradients", self.iter_)
-        with obs.span("GBDT::iteration"):
+        # round_scope splits the span's wall time into host vs device
+        # shares from the device-seconds estimate accumulated inside it
+        # (no-op unless devprof is on — the span itself never syncs)
+        with obs.devprof.round_scope(), obs.span("GBDT::iteration"):
             return self._train_one_iter_impl(grad, hess)
 
     # -- distributed desync detection ----------------------------------
@@ -2104,6 +2108,7 @@ class GBDT:
             bpad = np.zeros((bins_np.shape[0], bucket), np.int32)
             bpad[:, :m] = bins_np[:, off:off + m]
             dev_chunks.append((off, m, bucket, jnp.asarray(bpad)))
+            obs.devprof.transfer("h2d", "predict", int(bpad.nbytes))
         # continued training may hold trees larger than grow_params allows
         L = max(max(t.num_leaves for t in self.models[:n_models]), 2)
         out = np.zeros((self.num_class, n), np.float64)

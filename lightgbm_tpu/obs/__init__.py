@@ -46,6 +46,13 @@ one end-to-end number.  Pieces:
 - ``memwatch``: HBM watermark gauges (live/peak device bytes, per span
   phase) sampled at span boundaries; off by default
   (``memwatch``/``LIGHTGBM_TPU_MEMWATCH``).
+- ``devprof`` + ``devcaps``: device-time attribution — sampled
+  per-program device-seconds histograms via forced syncs at the
+  InstrumentedJit dispatch seam, static-cost roofline gauges against a
+  per-platform capability table, and H2D/D2H transfer accounting per
+  phase; off by default (``devprof``/``LIGHTGBM_TPU_DEVPROF``),
+  surfaced by ``obs-report --profile`` and bench.py's ``profile`` block
+  (docs/OBSERVABILITY.md §Device-time attribution).
 - ``tracing``: parent-linked span trees with trace IDs — one trace per
   serve HTTP request (queue -> coalesced batch -> device predict, with
   explicit many-to-one coalesce edges) and per boosting round — exported
@@ -53,11 +60,13 @@ one end-to-end number.  Pieces:
   (``trace_events_file``/``LIGHTGBM_TPU_TRACE_EVENTS``).
 """
 
+from . import devcaps, devprof  # noqa: F401
 from .compile_ledger import (InstrumentedJit, abstract_shapes,  # noqa: F401
                              instrumented_jit)
 from .events import SCHEMA_VERSION, EventRecorder, read_events  # noqa: F401
 from .phases import (DEVICE_PARENT, DEVICE_PHASES,  # noqa: F401
-                     HOST_PHASES, JITTED_HOST_PHASES, span_series)
+                     HOST_PHASES, JITTED_HOST_PHASES,
+                     TRANSFER_PHASES, span_series)
 from .prom import labeled_name, split_series  # noqa: F401
 from .registry import (DEFAULT_BYTE_BUCKETS,  # noqa: F401
                        DEFAULT_TIME_BUCKETS, REGISTRY, Registry,
@@ -103,4 +112,5 @@ __all__ = [
     "instrumented_jit", "InstrumentedJit", "abstract_shapes",
     "TRACER", "trace_span", "trace_begin", "trace_end", "trace_link",
     "HOST_PHASES", "DEVICE_PHASES", "DEVICE_PARENT", "JITTED_HOST_PHASES",
+    "TRANSFER_PHASES", "devprof", "devcaps",
 ]

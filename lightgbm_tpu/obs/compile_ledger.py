@@ -39,7 +39,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from . import registry
+from . import devprof, registry
 
 ENV_PATH = "LIGHTGBM_TPU_COMPILE_LEDGER"
 
@@ -111,19 +111,27 @@ def summary(k: int = 5) -> Dict[str, Any]:
     }
 
 
-def record(program: str, shapes: str, seconds: float) -> Dict[str, Any]:
+def record(program: str, shapes: str, seconds: float,
+           cost: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Append one compile event; feeds the registry series and the JSONL
     sink.  Called by the instrumented jits — safe to call directly for
-    compilations detected by other means."""
+    compilations detected by other means.  ``cost`` is the program's
+    static cost-analysis row (``_cost_analysis``); the three fields are
+    present on every event — None when profiling was off or the backend
+    reported nothing — so ledger consumers see one schema."""
     global _dropped
     registry.inc("compile_count")
     registry.inc("compile_count_" + _sanitize(program))
     registry.observe("compile_seconds", float(seconds))
+    cost = cost or {}
     ev = {
         "program": str(program),
         "shapes": str(shapes),
         "seconds": round(float(seconds), 6),
         "t": round(time.time(), 3),
+        "flops": cost.get("flops"),
+        "bytes_accessed": cost.get("bytes_accessed"),
+        "output_bytes": cost.get("output_bytes"),
     }
     with _lock:
         ev["count"] = registry.get_counter("compile_count")
@@ -200,6 +208,41 @@ def _in_trace() -> bool:
         return False
 
 
+def _cost_analysis(fn, args: tuple,
+                   kwargs: dict) -> Optional[Dict[str, float]]:
+    """``flops`` / ``bytes_accessed`` / ``output_bytes`` from XLA's
+    static cost model for the executable this call shape compiled, or
+    None when the backend reports nothing.  Re-lowers and AOT-compiles
+    (cache-served, but not free) — only called while devprof is on, on
+    compile events."""
+    try:
+        ca = fn.lower(*args, **kwargs).compile().cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):      # older jax: one dict per device
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+
+    def _pick(*names: str) -> Optional[float]:
+        for n in names:
+            v = ca.get(n)
+            if v is not None:
+                try:
+                    return float(v)
+                except (TypeError, ValueError):
+                    continue
+        return None
+
+    out = {
+        "flops": _pick("flops"),
+        "bytes_accessed": _pick("bytes accessed", "bytes_accessed"),
+        "output_bytes": _pick("bytes accessed output",
+                              "bytes_accessed_output"),
+    }
+    return out if any(v is not None for v in out.values()) else None
+
+
 class InstrumentedJit:
     """Wrap a jitted callable; every XLA compilation it triggers lands
     in the compile ledger (and the ``compile_count``/``compile_seconds``
@@ -234,8 +277,15 @@ class InstrumentedJit:
 
     def _dispatch(self, *args, **kwargs):
         """The one seam every instrumented dispatch passes through —
-        where ``testing.faults.oom_on_program`` injects and where a real
-        XLA ``RESOURCE_EXHAUSTED`` surfaces."""
+        where ``testing.faults.oom_on_program`` injects, where a real
+        XLA ``RESOURCE_EXHAUSTED`` surfaces, and where devprof samples
+        device time.  Off costs one module-attribute read; inside
+        another jit's trace the sampler must not run (a block_until_ready
+        on tracers is meaningless)."""
+        if devprof.ENABLED and not _in_trace():
+            return devprof.timed_dispatch(self.program, self._fn,
+                                          args, kwargs,
+                                          cache_size=self._cache_size)
         return self._fn(*args, **kwargs)
 
     def _call_guarded(self, *args, **kwargs):
@@ -270,7 +320,13 @@ class InstrumentedJit:
             compiled = key not in self._seen_keys
             self._seen_keys.add(key)
         if compiled:
-            record(self.program, abstract_shapes(args, kwargs), dt)
+            cost = None
+            if devprof.ENABLED:
+                cost = _cost_analysis(self._fn, args, kwargs)
+                if cost:
+                    devprof.note_cost(self.program, cost)
+            record(self.program, abstract_shapes(args, kwargs), dt,
+                   cost=cost)
         return out, compiled
 
     def __call__(self, *args, **kwargs):

@@ -1,0 +1,393 @@
+"""Device-time attribution: sampled per-program device timing, roofline
+gauges, and host<->device transfer accounting.
+
+JAX dispatch is asynchronous: the wall time around a jitted call measures
+*dispatch*, not execution, so the repo could count compiles
+(``compile_ledger``) and time host spans (``spans``) but never answer
+"which XLA program burned the device time this round".  This module
+answers it from the single seam every repo jit already routes through —
+``obs.InstrumentedJit._dispatch`` — with a sampling design whose OFF
+state is provably free:
+
+- ``devprof`` param / ``LIGHTGBM_TPU_DEVPROF`` env (env wins):
+  ``off`` | ``full`` | ``sample:N``.  Off is one module-attribute read
+  per dispatch — no sync, no new XLA program, no registry traffic
+  (tests/test_devprof.py pins this against the compile ledger).
+- when on, every Nth dispatch of each program (N=1 under ``full``) is
+  followed by ``jax.block_until_ready`` and the measured wall time lands
+  in ``device_seconds_total`` / ``device_seconds_<program>`` histograms.
+  Each sample also adds ``dt * N`` to a per-program running *estimate*
+  (``devprof_device_seconds_est_<program>`` gauges) — the sampling
+  correction that keeps totals unbiased: E[sum of dt*N over sampled
+  calls] = sum of all calls' device time, assuming per-program durations
+  are stationary across the sampling stride.
+- a forced sync measures "time until this program's outputs are ready",
+  which includes any previously queued device work — an *attribution*
+  instrument (who is the time charged to), not a per-kernel profiler;
+  docs/OBSERVABILITY.md spells out the caveats.
+- ``roofline``: at compile time the ledger captures XLA's static cost
+  analysis (``compile_ledger._cost_analysis`` -> ``note_cost`` here);
+  each sample then updates ``devprof_achieved_flops_<program>`` /
+  ``devprof_roofline_pct_<program>`` gauges against the ``devcaps``
+  capability table.
+- ``transfer(direction, phase, nbytes)``: always-on counters for the
+  H2D/D2H feed points (``h2d_bytes_total``, ``h2d_bytes_<phase>``, and
+  the d2h mirrors), plus the pre-existing legacy
+  ``host_to_device_*`` / ``device_to_host_*`` names so dashboards and
+  bench tails keep reading.
+- ``sync(value, source)``: the one timed ``block_until_ready`` helper
+  for instruments that serialize on purpose (``obs.span`` under TIMETAG,
+  ``utils/timetag.scope``) — their perturbation lands in
+  ``devprof_forced_sync_seconds`` so a TIMETAG run's profile shows its
+  own measurement cost instead of silently absorbing it.
+
+Everything lands in the process registry, so ``/metrics``, ``/stats``,
+``obs-report --profile`` and bench.py's ``profile`` block all read the
+same account.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from . import devcaps, phases, registry
+
+ENV = "LIGHTGBM_TPU_DEVPROF"
+
+ENABLED = False
+MODE = "off"            # resolved mode string: "off" | "full" | "sample:N"
+_INTERVAL = 0           # sample every Nth dispatch per program (0 = off)
+
+_lock = threading.Lock()
+_dispatches: Dict[str, int] = {}    # sanitized program -> dispatch count
+_samples: Dict[str, int] = {}       # sanitized program -> sampled count
+_est: Dict[str, float] = {}         # sanitized program -> corrected seconds
+_costs: Dict[str, Dict[str, Optional[float]]] = {}  # -> cost-analysis row
+_names: Dict[str, str] = {}         # raw program -> sanitized (memo)
+_last_out: Dict[str, Any] = {}      # -> previous dispatch output (pre-drain)
+_caps: Optional[Dict[str, Any]] = None
+
+_tls = threading.local()            # .bucket: serve padding-bucket context
+
+
+def parse_mode(raw: Any) -> Tuple[str, int]:
+    """``off | full | sample:N`` -> ``(mode, interval)``; truthy/falsy
+    spellings ("1", "true", "0", "") are accepted for env-var ergonomics.
+    Raises ValueError on anything else — config validation calls this so
+    a typo'd param dies at set-params time, not silently off."""
+    if raw is None:
+        return "off", 0
+    s = str(raw).strip().lower()
+    if s in ("", "off", "0", "false", "no", "none"):
+        return "off", 0
+    if s in ("full", "1", "true", "yes", "on"):
+        return "full", 1
+    if s.startswith("sample:"):
+        try:
+            n = int(s.split(":", 1)[1])
+        except ValueError:
+            n = 0
+        if n >= 1:
+            return "sample", n
+    raise ValueError(
+        f"devprof={raw!r}: expected off | full | sample:N (N >= 1)")
+
+
+def _apply(mode: str, interval: int) -> None:
+    global ENABLED, MODE, _INTERVAL
+    if mode == "off":
+        ENABLED, MODE, _INTERVAL = False, "off", 0
+        _last_out.clear()       # release held outputs when disarming
+    else:
+        ENABLED = True
+        MODE = "full" if mode == "full" else f"sample:{interval}"
+        _INTERVAL = int(interval)
+    # numeric mode gauge (0 = off, 1 = full, N = sampling stride): lets a
+    # registry snapshot carry the mode into obs-report --profile files
+    registry.set_gauge("devprof_sample_interval", _INTERVAL)
+
+
+def enable(mode: Any = "full") -> str:
+    """Programmatic switch (tests, notebooks): returns the new MODE."""
+    _apply(*parse_mode(mode))
+    return MODE
+
+
+def configure(flag: Any = None) -> str:
+    """Resolve the mode for a run: ``LIGHTGBM_TPU_DEVPROF`` wins over the
+    ``devprof`` param; absent both DISARMS — each run's configuration is
+    authoritative (same contract as memwatch/compile_ledger.configure).
+    A malformed env value warns and disarms (the run must not die on a
+    profiling knob); a malformed *param* raises, but config validation
+    normally rejects it earlier.  Returns the effective MODE."""
+    env = os.environ.get(ENV, "").strip()
+    if env:
+        try:
+            mode, n = parse_mode(env)
+        except ValueError:
+            from ..utils import log
+            log.warning("%s=%r is not off|full|sample:N; devprof disabled",
+                        ENV, env)
+            mode, n = "off", 0
+    else:
+        mode, n = parse_mode(flag)
+    _apply(mode, n)
+    return MODE
+
+
+def reset() -> None:
+    """Clear the per-program accumulators (tests).  Registry series
+    already written are left alone, like compile_ledger.reset()."""
+    global _caps
+    with _lock:
+        _dispatches.clear()
+        _samples.clear()
+        _est.clear()
+        _costs.clear()
+        _names.clear()
+        _last_out.clear()
+        _caps = None
+
+
+def _prog(program: str) -> str:
+    s = _names.get(program)
+    if s is None:
+        s = _names[program] = phases.sanitize(program)
+    return s
+
+
+def _capabilities() -> Dict[str, Any]:
+    global _caps
+    caps = _caps
+    if caps is None:
+        caps = _caps = devcaps.capabilities()
+    return caps
+
+
+# -- sampled dispatch timing (the InstrumentedJit._dispatch hook) --------
+
+def timed_dispatch(program: str, dispatch: Callable,
+                   args: tuple, kwargs: dict,
+                   cache_size: Optional[Callable[[], Optional[int]]] = None):
+    """Run one instrumented dispatch; on every Nth call of ``program``
+    block until its outputs are ready and record the wall time.  Returns
+    the dispatch result unchanged.  Only called while ENABLED and
+    outside a jit trace (compile_ledger gates both).
+
+    A sampled dispatch that turns out to have COMPILED (``cache_size``
+    grew across the call) is discarded: its wall time is dominated by
+    tracing+XLA compilation, which is the compile ledger's account —
+    folding it into the device-seconds estimate would charge a one-time
+    host cost to steady-state device time (and make ``full`` disagree
+    with ``sample:N``, whose first sample usually lands on a warm
+    dispatch).
+
+    Before the timed window opens, the dispatch BACKLOG is drained
+    (``_drain``): the stride's N-1 un-synced dispatches (plus any other
+    program's queued work) are still in flight, and a sync that absorbs
+    them would measure ~N executions and the xN correction would
+    overcount by ~N.  Draining first makes each sample measure ONE
+    uncontended execution in both the host-bound (queue already empty)
+    and device-bound (deep backlog) regimes — the stationarity
+    assumption is then the only estimator error.  The drain handles are
+    each program's previous output, held one dispatch long while
+    profiling is armed (a bounded, documented memory cost of turning
+    the profiler on)."""
+    prog = _prog(program)
+    with _lock:
+        n = _dispatches.get(prog, 0) + 1
+        _dispatches[prog] = n
+        interval = _INTERVAL
+    registry.inc("devprof_dispatches_total")
+    registry.inc("devprof_dispatches_" + prog)
+    if interval <= 0 or n % interval:
+        out = dispatch(*args, **kwargs)
+        _last_out[prog] = out
+        return out
+    _drain(list(_last_out.values()))
+    before = cache_size() if cache_size is not None else None
+    t0 = time.perf_counter()
+    out = dispatch(*args, **kwargs)
+    import jax
+    try:
+        jax.block_until_ready(out)
+    except Exception:   # non-array outputs: time the dispatch we got
+        pass
+    dt = time.perf_counter() - t0
+    _last_out[prog] = out
+    if before is not None:
+        after = cache_size()
+        if after is not None and after > before:
+            registry.inc("devprof_samples_skipped_compile")
+            return out
+    _record_sample(prog, dt, interval)
+    return out
+
+
+def _drain(prev: Any) -> None:
+    """Block on previously dispatched outputs, leaf by leaf: when the
+    non-donated leaves are ready the producing computations have
+    finished, so every queue devprof has seen is empty and the timed
+    window that follows measures one uncontended execution.  Donated
+    leaves (train_step's score buffer) may already be deleted by a
+    later dispatch — skipped; any surviving sibling leaf of the same
+    computation still drains it."""
+    if prev is None:
+        return
+    import jax
+    for leaf in jax.tree_util.tree_leaves(prev):
+        try:
+            jax.block_until_ready(leaf)
+        except Exception:
+            continue
+
+
+def _record_sample(prog: str, dt: float, interval: int) -> None:
+    registry.observe("device_seconds_total", dt)
+    registry.observe("device_seconds_" + prog, dt)
+    bucket = getattr(_tls, "bucket", None)
+    if bucket is not None:
+        registry.observe(f"device_seconds_{prog}_bucket_{bucket}", dt)
+    registry.inc("devprof_samples_total")
+    registry.inc("devprof_samples_" + prog)
+    with _lock:
+        _samples[prog] = _samples.get(prog, 0) + 1
+        _est[prog] = _est.get(prog, 0.0) + dt * interval
+        est = _est[prog]
+        total = sum(_est.values())
+        cost = _costs.get(prog)
+    registry.set_gauge("devprof_device_seconds_est_" + prog, round(est, 6))
+    registry.set_gauge("devprof_device_seconds_est_total", round(total, 6))
+    if cost:
+        rl = devcaps.roofline(cost.get("flops"), cost.get("bytes_accessed"),
+                              dt, _capabilities())
+        if rl["achieved_flops"] is not None:
+            registry.set_gauge("devprof_achieved_flops_" + prog,
+                               round(rl["achieved_flops"], 1))
+        if rl["roofline_pct"] is not None:
+            registry.set_gauge("devprof_roofline_pct_" + prog,
+                               round(rl["roofline_pct"], 3))
+
+
+def note_cost(program: str, cost: Dict[str, Optional[float]]) -> None:
+    """Stash a program's static cost-analysis row (compile_ledger calls
+    this on each compile while profiling) and expose the counts as
+    gauges so snapshots carry them into reports."""
+    prog = _prog(program)
+    with _lock:
+        _costs[prog] = dict(cost)
+    for key in ("flops", "bytes_accessed", "output_bytes"):
+        v = cost.get(key)
+        if v is not None:
+            registry.set_gauge(f"devprof_{key}_{prog}", float(v))
+
+
+# -- counted forced syncs (the serializing instruments' one sync path) ---
+
+def sync(value: Any, source: str = "span") -> float:
+    """Timed ``jax.block_until_ready`` for instruments that serialize on
+    purpose (obs.span under TIMETAG, timetag.scope).  The wait itself is
+    recorded — ``devprof_forced_sync_seconds`` histogram +
+    ``devprof_forced_syncs_total`` counter — so a serializing run's
+    profile shows its own measurement perturbation.  Returns the wait
+    seconds."""
+    import jax
+    t0 = time.perf_counter()
+    try:
+        jax.block_until_ready(value)
+    finally:
+        dt = time.perf_counter() - t0
+        registry.observe("devprof_forced_sync_seconds", dt)
+        registry.inc("devprof_forced_syncs_total")
+        registry.inc("devprof_forced_syncs_" + phases.sanitize(source))
+    return dt
+
+
+# -- transfer accounting -------------------------------------------------
+
+def transfer(direction: str, phase: str, nbytes: int,
+             transfers: int = 1) -> None:
+    """Account one host<->device transfer batch under a
+    ``phases.TRANSFER_PHASES`` phase.  Counter bumps only — always on,
+    nothing here touches the device.  Keeps the legacy
+    ``host_to_device_*`` / ``device_to_host_*`` names alive alongside
+    the per-phase ``h2d_bytes_<phase>`` / ``d2h_bytes_<phase>`` split."""
+    nbytes = int(nbytes)
+    transfers = int(transfers)
+    if direction == "h2d":
+        legacy, short = "host_to_device", "h2d"
+    elif direction == "d2h":
+        legacy, short = "device_to_host", "d2h"
+    else:
+        raise ValueError(f"transfer direction {direction!r}: h2d or d2h")
+    registry.inc(legacy + "_transfers", transfers)
+    registry.inc(legacy + "_bytes", nbytes)
+    registry.inc(short + "_transfers_total", transfers)
+    registry.inc(short + "_bytes_total", nbytes)
+    registry.inc(f"{short}_bytes_{phases.sanitize(phase)}", nbytes)
+
+
+# -- scopes --------------------------------------------------------------
+
+@contextmanager
+def bucket_scope(bucket: int):
+    """Serve-side context: samples taken inside also land in
+    ``device_seconds_<program>_bucket_<B>`` (CountingJit wraps each
+    padded-bucket dispatch in this)."""
+    prev = getattr(_tls, "bucket", None)
+    _tls.bucket = int(bucket)
+    try:
+        yield
+    finally:
+        _tls.bucket = prev
+
+
+@contextmanager
+def round_scope():
+    """Host-vs-device split for one boosting round: wall time around the
+    block, minus the device-seconds estimate accumulated inside it, is
+    the host share.  No-op (and no clock read) while disabled."""
+    if not ENABLED:
+        yield
+        return
+    t0 = time.perf_counter()
+    with _lock:
+        d0 = sum(_est.values())
+    try:
+        yield
+    finally:
+        wall = time.perf_counter() - t0
+        with _lock:
+            dev = sum(_est.values()) - d0
+        # the sampling correction is unbiased but noisy; a single round's
+        # estimate can overshoot its own wall clock — clamp so the split
+        # stays a partition of the round
+        dev = min(max(dev, 0.0), wall)
+        registry.observe("devprof_round_device_seconds", dev)
+        registry.observe("devprof_round_host_seconds", wall - dev)
+        registry.inc("devprof_rounds_total")
+
+
+# -- snapshots -----------------------------------------------------------
+
+def estimates() -> Dict[str, Dict[str, Any]]:
+    """Per-program account: ``{prog: {device_seconds_est, samples,
+    dispatches, flops, bytes_accessed, output_bytes}}`` — the live-state
+    source for bench.py's ``profile`` block."""
+    with _lock:
+        out: Dict[str, Dict[str, Any]] = {}
+        for prog, est in _est.items():
+            cost = _costs.get(prog) or {}
+            out[prog] = {
+                "device_seconds_est": round(est, 6),
+                "samples": _samples.get(prog, 0),
+                "dispatches": _dispatches.get(prog, 0),
+                "flops": cost.get("flops"),
+                "bytes_accessed": cost.get("bytes_accessed"),
+                "output_bytes": cost.get("output_bytes"),
+            }
+        return out

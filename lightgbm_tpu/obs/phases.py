@@ -76,6 +76,17 @@ JITTED_HOST_PHASES = frozenset({
     "Predict::forest",
 })
 
+# Host<->device transfer accounting phases (obs/devprof.py transfer()):
+# every H2D/D2H feed point charges its bytes to one of these, so the
+# h2d_bytes_<phase>/d2h_bytes_<phase> counter namespace stays closed.
+TRANSFER_PHASES = frozenset({
+    "dataset",     # _DeviceData construction: binned matrix + labels up
+    "host_tree",   # grown-tree materialization: device tree arrays down
+    "predict",     # chunked training-side predict feeding
+    "forest",      # CompiledForest build / to_device weight placement
+    "serve",       # serve-path request payloads (batcher/forest calls)
+})
+
 
 def sanitize(name):
     """Deterministic Prometheus-safe stem for any series/phase name:

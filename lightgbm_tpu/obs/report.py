@@ -29,7 +29,13 @@ Two sibling inputs ride the same CLI (docs/OBSERVABILITY.md):
 - ``--traces`` switches the positional files to Chrome trace-event JSON
   (the ``trace_events_file`` export): per-root span stats, coalesce
   fan-in, and the critical path of the slowest requests/rounds
-  (queue -> batch -> device predict decomposition).
+  (queue -> batch -> device predict decomposition);
+- ``--profile`` switches the positional files to registry-snapshot JSON
+  (``obs.snapshot()`` dumps; none = the live process registry) and
+  prints the devprof decomposition: per-round host/device split, top-k
+  programs by estimated device seconds with roofline %, H2D/D2H bytes
+  per phase, forced-sync cost (docs/OBSERVABILITY.md §Device-time
+  attribution).
 """
 
 from __future__ import annotations
@@ -186,6 +192,102 @@ def summarize(paths: Sequence[str], top_k: int = 5,
     return rep
 
 
+def profile_summary(snap: Optional[Dict[str, Any]] = None,
+                    top_k: int = 5) -> Dict[str, Any]:
+    """The devprof decomposition as one JSON-ready dict, computed from a
+    registry snapshot (default: the live process registry) — every field
+    derives from series devprof already published, so a snapshot written
+    by one process reports identically in another."""
+    from . import devcaps
+    from . import registry as _registry
+    if snap is None:
+        snap = _registry.REGISTRY.snapshot()
+    g = dict(snap.get("gauges", {}))
+    c = dict(snap.get("counters", {}))
+    h = dict(snap.get("histograms", {}))
+    interval = int(g.get("devprof_sample_interval", 0) or 0)
+    mode = "off" if interval <= 0 else \
+        ("full" if interval == 1 else f"sample:{interval}")
+
+    programs: Dict[str, Dict[str, Any]] = {}
+    prefix = "devprof_device_seconds_est_"
+    for k, v in g.items():
+        if not k.startswith(prefix):
+            continue
+        prog = k[len(prefix):]
+        if prog == "total":
+            continue
+        programs[prog] = {
+            "device_seconds_est": float(v),
+            "samples": int(c.get("devprof_samples_" + prog, 0)),
+            "dispatches": int(c.get("devprof_dispatches_" + prog, 0)),
+            "flops": g.get("devprof_flops_" + prog),
+            "bytes_accessed": g.get("devprof_bytes_accessed_" + prog),
+            "output_bytes": g.get("devprof_output_bytes_" + prog),
+            "achieved_flops": g.get("devprof_achieved_flops_" + prog),
+            "roofline_pct": g.get("devprof_roofline_pct_" + prog),
+        }
+    top = sorted(programs,
+                 key=lambda p: -programs[p]["device_seconds_est"])
+    top = top[: max(int(top_k), 0)]
+
+    def _phase_bytes(short: str) -> Dict[str, int]:
+        pre = short + "_bytes_"
+        return {k[len(pre):]: int(v) for k, v in sorted(c.items())
+                if k.startswith(pre) and k != short + "_bytes_total"}
+
+    rh = h.get("devprof_round_host_seconds") or {}
+    rd = h.get("devprof_round_device_seconds") or {}
+    fs = h.get("devprof_forced_sync_seconds") or {}
+    buckets = {k: {"samples": int(v.get("count", 0)),
+                   "seconds": round(float(v.get("sum", 0.0)), 6)}
+               for k, v in sorted(h.items())
+               if k.startswith("device_seconds_") and "_bucket_" in k}
+    return {
+        "mode": mode,
+        "device": devcaps.capabilities(),
+        "rounds": {
+            "count": int(c.get("devprof_rounds_total", 0)),
+            "host_seconds": round(float(rh.get("sum", 0.0)), 6),
+            "device_seconds": round(float(rd.get("sum", 0.0)), 6),
+        },
+        "device_seconds_est_total": float(
+            g.get("devprof_device_seconds_est_total", 0.0) or 0.0),
+        "samples_total": int(c.get("devprof_samples_total", 0)),
+        "dispatches_total": int(c.get("devprof_dispatches_total", 0)),
+        "programs": programs,
+        "top": top,
+        "transfers": {
+            "h2d_bytes_total": int(c.get("h2d_bytes_total", 0)),
+            "h2d_transfers_total": int(c.get("h2d_transfers_total", 0)),
+            "h2d_by_phase": _phase_bytes("h2d"),
+            "d2h_bytes_total": int(c.get("d2h_bytes_total", 0)),
+            "d2h_transfers_total": int(c.get("d2h_transfers_total", 0)),
+            "d2h_by_phase": _phase_bytes("d2h"),
+        },
+        "forced_syncs": {
+            "count": int(c.get("devprof_forced_syncs_total", 0)),
+            "seconds": round(float(fs.get("sum", 0.0)), 6),
+        },
+        "serve_buckets": buckets,
+    }
+
+
+def profile_summary_from_files(paths: Sequence[str],
+                               top_k: int = 5) -> Dict[str, Any]:
+    """``--profile`` over registry-snapshot JSON files: fold them through
+    a fresh Registry (counters/histograms add, gauges last-write-wins)
+    and summarize the merged account.  No files = the live registry."""
+    if not paths:
+        return profile_summary(top_k=top_k)
+    from .registry import Registry
+    r = Registry()
+    for p in paths:
+        with open(p) as fh:
+            r.merge(json.load(fh))
+    return profile_summary(r.snapshot(), top_k=top_k)
+
+
 def _fmt_bytes(n: int) -> str:
     v = float(n)
     for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
@@ -290,15 +392,74 @@ def render_traces_table(rep: Dict[str, Any]) -> str:
     return "\n".join(out)
 
 
+def render_profile_table(rep: Dict[str, Any]) -> str:
+    """Human-readable ``--profile`` decomposition."""
+    out: List[str] = []
+    out.append("== obs-report (profile) ==")
+    dev = rep["device"]
+    peaks = ""
+    if dev.get("peak_flops") or dev.get("peak_bytes_per_sec"):
+        peaks = (f", peaks {dev.get('peak_flops'):.3g} FLOP/s / "
+                 f"{dev.get('peak_bytes_per_sec'):.3g} B/s "
+                 f"({dev.get('source')})")
+    out.append(f"mode: {rep['mode']}   device: {dev.get('device_kind')} "
+               f"[{dev.get('platform')}]{peaks}")
+    r = rep["rounds"]
+    if r["count"]:
+        total = (r["host_seconds"] + r["device_seconds"]) or 1.0
+        out.append(f"rounds: {r['count']}  host {r['host_seconds']:.3f}s / "
+                   f"device {r['device_seconds']:.3f}s "
+                   f"(device {100.0 * r['device_seconds'] / total:.1f}%)")
+    out.append(f"sampled dispatches: {rep['samples_total']} of "
+               f"{rep['dispatches_total']}, estimated device total "
+               f"{rep['device_seconds_est_total']:.3f}s")
+    if rep["top"]:
+        out.append(f"-- top {len(rep['top'])} programs by estimated "
+                   f"device seconds --")
+        for prog in rep["top"]:
+            p = rep["programs"][prog]
+            fl = p.get("flops")
+            af = p.get("achieved_flops")
+            rl = p.get("roofline_pct")
+            out.append(
+                f"  {prog:<28} {p['device_seconds_est']:>9.4f}s  "
+                f"x{p['samples']}/{p['dispatches']}"
+                + (f"  flops {fl:.3g}" if fl is not None else "")
+                + (f"  {af:.3g} FLOP/s" if af is not None else "")
+                + (f"  {rl:.2f}% roofline" if rl is not None else ""))
+    tr = rep["transfers"]
+    for short in ("h2d", "d2h"):
+        by = tr[f"{short}_by_phase"]
+        phases = ", ".join(f"{k} {_fmt_bytes(v)}" for k, v in by.items())
+        out.append(f"-- {short}: {_fmt_bytes(tr[f'{short}_bytes_total'])} "
+                   f"over {tr[f'{short}_transfers_total']} transfers"
+                   + (f" ({phases})" if phases else "") + " --")
+    fsn = rep["forced_syncs"]
+    if fsn["count"]:
+        out.append(f"-- forced syncs (TIMETAG/span serialization): "
+                   f"{fsn['count']}, {fsn['seconds']:.4f}s --")
+    if rep["serve_buckets"]:
+        out.append("-- per-bucket device seconds (serve) --")
+        for name, st in rep["serve_buckets"].items():
+            out.append(f"  {name:<40} {st['seconds']:>9.4f}s  "
+                       f"x{st['samples']}")
+    if rep["mode"] == "off" and not rep["programs"]:
+        out.append("(devprof was off — run with devprof=sample:N or "
+                   "LIGHTGBM_TPU_DEVPROF=full to populate this report)")
+    return "\n".join(out)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     """CLI entry: ``python -m lightgbm_tpu obs-report <events.jsonl ...>
-    [--format=json|table] [--top=K] [--compile=<ledger.jsonl>]`` or
-    ``obs-report --traces <trace.json ...>``."""
+    [--format=json|table] [--top=K] [--compile=<ledger.jsonl>]``,
+    ``obs-report --traces <trace.json ...>``, or
+    ``obs-report --profile [<registry_snapshot.json ...>]``."""
     argv = list(sys.argv[1:] if argv is None else argv)
     fmt = "table"
     top_k = 5
     compile_path: Optional[str] = None
     traces_mode = False
+    profile_mode = False
     paths: List[str] = []
     for tok in argv:
         if tok.startswith("--format="):
@@ -314,17 +475,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             compile_path = tok.split("=", 1)[1]
         elif tok == "--traces":
             traces_mode = True
+        elif tok == "--profile":
+            profile_mode = True
         elif tok.startswith("-"):
             print(f"obs-report: unknown flag {tok!r}", file=sys.stderr)
             return 2
         else:
             paths.append(tok)
-    if not paths:
+    if not paths and not profile_mode:
         print("usage: python -m lightgbm_tpu obs-report <events.jsonl ...> "
               "[--format=json|table] [--top=K] "
               "[--compile=<compile_ledger.jsonl>]\n"
               "       python -m lightgbm_tpu obs-report --traces "
-              "<trace_events.json ...> [--format=json|table] [--top=K]",
+              "<trace_events.json ...> [--format=json|table] [--top=K]\n"
+              "       python -m lightgbm_tpu obs-report --profile "
+              "[<registry_snapshot.json ...>] [--format=json|table] "
+              "[--top=K]",
               file=sys.stderr)
         return 2
     if fmt not in ("json", "table"):
@@ -332,7 +498,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               file=sys.stderr)
         return 2
     try:
-        if traces_mode:
+        if profile_mode:
+            rep = profile_summary_from_files(paths, top_k=top_k)
+        elif traces_mode:
             from .tracing import summarize_traces
             rep = summarize_traces(paths, top_k=top_k)
         else:
@@ -344,6 +512,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 1
     if fmt == "json":
         print(json.dumps(rep, indent=2, sort_keys=True))
+    elif profile_mode:
+        print(render_profile_table(rep))
     elif traces_mode:
         print(render_traces_table(rep))
     else:

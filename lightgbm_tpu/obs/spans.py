@@ -40,7 +40,7 @@ import time
 from contextlib import contextmanager
 from typing import Callable, Optional, Sequence
 
-from . import memwatch, phases, registry, tracing
+from . import devprof, memwatch, phases, registry, tracing
 
 
 # span names are a small fixed set (the phase taxonomy); memoize the
@@ -90,8 +90,9 @@ def span(name: str, buckets: Optional[Sequence[float]] = None,
         yield handle
     finally:
         if serialize and handle.value is not None:
-            import jax
-            jax.block_until_ready(handle.value)
+            # counted sync (obs/devprof.py): the serializing TIMETAG
+            # mode's perturbation shows up in its own profile
+            devprof.sync(handle.value, source=name)
         dt = time.perf_counter() - t0
         r.observe(_series(name), dt, buckets)
         if serialize:

@@ -121,7 +121,11 @@ class CountingJit(obs.InstrumentedJit):
         self.prefix = prefix
 
     def __call__(self, bucket: int, *args, **kwargs):
-        out, compiled = self._call_counted(*args, **kwargs)
+        # bucket_scope: devprof samples taken inside this dispatch also
+        # land in device_seconds_<program>_bucket_<B> (per-bucket device
+        # time at /metrics); no-op overhead while profiling is off
+        with obs.devprof.bucket_scope(bucket):
+            out, compiled = self._call_counted(*args, **kwargs)
         obs.inc(f"{self.prefix}_calls")
         if compiled:
             obs.inc(f"{self.prefix}_compiles")

@@ -199,6 +199,11 @@ class CompiledForest:
         # default placement (first local device); serve/fleet.py pins
         # per-replica copies with to_device()
         self.device = None
+        obs.devprof.transfer(
+            "h2d", "forest",
+            int(bnd.nbytes) + int(cats.nbytes) + int(is_cat.nbytes)
+            + sum(int(a.nbytes) for a in self._tree_dev),
+            transfers=3 + len(self._tree_dev))
         obs.inc("forest_compile_artifacts")
         obs.set_gauge("forest_trees", int(n_models))
         obs.set_gauge("forest_leaves_padded", int(self.num_leaves))
@@ -335,8 +340,11 @@ class CompiledForest:
         for off, n, bucket in self.ladder.chunks(N):
             Xp, mask = pad_rows(X[off:off + n], bucket)
             bins = self.bin_rows(Xp)
+            obs.devprof.transfer("h2d", "serve",
+                                 int(np.asarray(bins).nbytes))
             with timetag.scope("Predict::forest"):
                 raw = self._binned_jit(bucket, self._tree_dev, bins, mask)
+            obs.devprof.transfer("d2h", "serve", int(raw.nbytes))
             parts.append(np.asarray(raw, np.float64)[:, :n])
         return np.concatenate(parts, axis=1)
 
@@ -351,10 +359,14 @@ class CompiledForest:
         raws, outs = [], []
         for off, n, bucket in self.ladder.chunks(N):
             Xp, mask = pad_rows(X[off:off + n], bucket)
+            obs.devprof.transfer("h2d", "serve",
+                                 int(Xp.nbytes) + int(mask.nbytes))
             with timetag.scope("Predict::forest"):
                 raw, out = self._raw_jit(bucket, self._tree_dev,
                                          self._bnd_dev, self._cats_dev,
                                          self._is_cat_dev, Xp, mask)
+            obs.devprof.transfer("d2h", "serve",
+                                 int(raw.nbytes) + int(out.nbytes))
             raws.append(np.asarray(raw)[:, :n])
             outs.append(np.asarray(out)[:, :n])
         return (np.concatenate(raws, axis=1), np.concatenate(outs, axis=1))
@@ -404,6 +416,12 @@ class CompiledForest:
         clone._binned_jit = CountingJit(clone._make_binned_fn(),
                                         "predict_forest")
         clone._raw_jit = CountingJit(clone._make_raw_fn(), "serve_forest")
+        obs.devprof.transfer(
+            "h2d", "forest",
+            sum(int(a.nbytes) for a in clone._tree_dev)
+            + int(clone._bnd_dev.nbytes) + int(clone._cats_dev.nbytes)
+            + int(clone._is_cat_dev.nbytes),
+            transfers=3 + len(clone._tree_dev))
         return clone
 
     def warmup(self, buckets: Optional[Sequence[int]] = None,

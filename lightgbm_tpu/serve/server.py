@@ -643,9 +643,10 @@ def serve_from_config(config, params=None) -> PredictServer:
     # deep-observability switches (docs/OBSERVABILITY.md): compile
     # ledger, HBM watermarks, causal trace export — all off unless
     # configured, all env-var overridable
-    from ..obs import compile_ledger, memwatch
+    from ..obs import compile_ledger, devprof, memwatch
     compile_ledger.configure(config.compile_ledger_file or None)
     memwatch.configure(config.memwatch)
+    devprof.configure(config.devprof)
     obs.TRACER.configure(config.trace_events_file or None)
     # Cap the ladder at serve_max_batch: warmup() compiles every bucket
     # the forest can ever pick, so an oversize request streams through

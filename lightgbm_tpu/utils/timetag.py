@@ -89,8 +89,10 @@ def scope(name: str):
         yield s
     finally:
         if s.value is not None:
-            import jax
-            jax.block_until_ready(s.value)
+            # counted sync (obs/devprof.py): this scope's serialization
+            # is visible in the profile it distorts
+            from ..obs import devprof
+            devprof.sync(s.value, source=name)
         dt = time.perf_counter() - t0
         _acc[name] += dt
         _cnt[name] += 1

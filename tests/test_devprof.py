@@ -1,0 +1,341 @@
+"""Device-time attribution (lightgbm_tpu/obs/devprof.py + devcaps.py):
+
+- the OFF state is ledger-pinned free: no devprof series, no forced
+  syncs, no new compile events beyond the function's own, and the
+  outputs stay bit-identical when profiling toggles on;
+- the sampling correction is unbiased: under a deterministic clock,
+  ``sample:N`` and ``full`` agree exactly on the estimated total;
+- compile cost fields (flops / bytes_accessed / output_bytes) ride the
+  ledger JSONL present-or-None in every mode;
+- roofline math (devcaps) is unit-pinned;
+- serve per-bucket device-seconds series render as valid Prometheus
+  text;
+- ``tools/bench_regress.py --program-threshold`` gates a synthetic
+  per-program regression and leaves profile-less baselines untouched.
+
+Process-global state (registry, ledger, devprof accumulators) is
+asserted by DELTA so this file composes with the rest of tier-1.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import compile_ledger, devcaps, devprof, prom, registry
+
+pytestmark = pytest.mark.devprof
+
+
+@pytest.fixture(autouse=True)
+def devprof_pristine(monkeypatch):
+    """Every test starts disarmed with a clean env and leaves no mode
+    behind; accumulators reset on both sides (registry series persist —
+    tests use unique program names and delta assertions)."""
+    monkeypatch.delenv(devprof.ENV, raising=False)
+    devprof.reset()
+    devprof.configure(None)
+    yield
+    devprof.reset()
+    devprof.configure(None)
+
+
+class _FakeClock:
+    """Deterministic perf_counter stand-in: advances 1.0 per call, so a
+    sampled dispatch (two reads) always measures dt == 1.0 regardless of
+    host load — which makes the sampling-correction identity EXACT."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def perf_counter(self):
+        self.t += 1.0
+        return self.t
+
+
+def _counters(*names):
+    return tuple(obs.get_counter(n) for n in names)
+
+
+# -- off is free ---------------------------------------------------------
+
+def test_off_is_ledger_pinned_free():
+    assert devprof.ENABLED is False and devprof.MODE == "off"
+    fn = obs.instrumented_jit(lambda x: x * 2 + 1, program="t_dp_off")
+    x = jnp.arange(16, dtype=jnp.float32)
+
+    c0 = _counters("devprof_dispatches_total", "devprof_samples_total",
+                   "devprof_forced_syncs_total")
+    compiles0 = obs.get_counter("compile_count")
+    events0 = len(compile_ledger.events())
+
+    out_off = np.asarray(fn(x))
+    out_off2 = np.asarray(fn(x))
+
+    # exactly the function's own compile, nothing from devprof
+    assert obs.get_counter("compile_count") - compiles0 == 1
+    assert len(compile_ledger.events()) - events0 == 1
+    assert _counters("devprof_dispatches_total", "devprof_samples_total",
+                     "devprof_forced_syncs_total") == c0
+    assert devprof.estimates() == {}
+
+    # toggling profiling ON must not create new XLA programs for an
+    # already-compiled function, and outputs stay bit-identical
+    devprof.enable("full")
+    out_on = np.asarray(fn(x))
+    assert obs.get_counter("compile_count") - compiles0 == 1
+    assert len(compile_ledger.events()) - events0 == 1
+    np.testing.assert_array_equal(out_off, out_on)
+    np.testing.assert_array_equal(out_off, out_off2)
+
+
+# -- sampling correction -------------------------------------------------
+
+def test_sampled_matches_full_under_deterministic_clock(monkeypatch):
+    x = jnp.arange(8, dtype=jnp.float32)
+    fn = obs.instrumented_jit(lambda v: v + 1, program="t_dp_full")
+    fn2 = obs.instrumented_jit(lambda v: v + 2, program="t_dp_samp")
+    fn(x), fn2(x)   # compile while disarmed: measure warm dispatches only
+
+    monkeypatch.setattr(devprof, "time", _FakeClock())
+    devprof.enable("full")
+    for _ in range(6):
+        fn(x)
+    full = devprof.estimates()["t_dp_full"]
+    assert full["dispatches"] == 6 and full["samples"] == 6
+    assert full["device_seconds_est"] == pytest.approx(6.0)
+
+    devprof.reset()
+    devprof.enable("sample:2")
+    assert devprof.MODE == "sample:2"
+    for _ in range(6):
+        fn2(x)
+    samp = devprof.estimates()["t_dp_samp"]
+    # every 2nd dispatch sampled, each dt corrected x2: exact agreement
+    assert samp["dispatches"] == 6 and samp["samples"] == 3
+    assert samp["device_seconds_est"] == pytest.approx(
+        full["device_seconds_est"])
+
+
+def test_compiling_dispatch_sample_is_discarded():
+    """Compile seconds are the ledger's account: a sample landing on
+    the compiling dispatch must not pollute the device-time estimate."""
+    devprof.enable("full")
+    skipped0 = obs.get_counter("devprof_samples_skipped_compile")
+    fn = obs.instrumented_jit(lambda v: v * 9, program="t_dp_skip")
+    x = jnp.arange(8, dtype=jnp.float32)
+    fn(x)                                     # compiles: sample discarded
+    assert "t_dp_skip" not in devprof.estimates()
+    assert obs.get_counter("devprof_samples_skipped_compile") == skipped0 + 1
+    fn(x)                                     # warm: sample lands
+    st = devprof.estimates()["t_dp_skip"]
+    assert st["dispatches"] == 2 and st["samples"] == 1
+
+
+def test_sample_interval_gauge_carries_mode():
+    assert obs.get_gauge("devprof_sample_interval") == 0
+    devprof.enable("sample:4")
+    assert obs.get_gauge("devprof_sample_interval") == 4
+    devprof.enable("full")
+    assert obs.get_gauge("devprof_sample_interval") == 1
+
+
+def test_env_wins_and_malformed_env_disarms(monkeypatch):
+    monkeypatch.setenv(devprof.ENV, "sample:3")
+    assert devprof.configure("full") == "sample:3"
+    monkeypatch.setenv(devprof.ENV, "sideways")
+    assert devprof.configure("full") == "off"      # warn + disarm
+    with pytest.raises(ValueError):
+        devprof.parse_mode("sample:0")
+    with pytest.raises(ValueError):
+        devprof.parse_mode("sideways")
+
+
+# -- cost fields in the ledger -------------------------------------------
+
+@pytest.fixture
+def ledger_file(tmp_path, monkeypatch):
+    path = tmp_path / "compile_ledger.jsonl"
+    monkeypatch.setenv(compile_ledger.ENV_PATH, str(path))
+    compile_ledger.configure()
+    yield path
+    monkeypatch.delenv(compile_ledger.ENV_PATH)
+    compile_ledger.configure()
+
+
+def test_cost_fields_round_trip_jsonl(ledger_file):
+    x = jnp.arange(32, dtype=jnp.float32)
+
+    devprof.enable("full")
+    obs.instrumented_jit(lambda v: v * 3, program="t_dp_cost_on")(x)
+    devprof.configure(None)
+    obs.instrumented_jit(lambda v: v * 5, program="t_dp_cost_off")(x)
+
+    rows = {}
+    with open(ledger_file) as fh:
+        for line in fh:
+            ev = json.loads(line)
+            rows[ev["program"]] = ev
+    on, off = rows["t_dp_cost_on"], rows["t_dp_cost_off"]
+    # keys are ALWAYS present; values populate only while profiling
+    for ev in (on, off):
+        assert {"flops", "bytes_accessed", "output_bytes"} <= set(ev)
+    assert on["flops"] is not None and on["flops"] > 0   # CPU cost model
+    assert off["flops"] is None
+
+    # and the gauges mirror the non-None fields for snapshot transport
+    assert obs.get_gauge("devprof_flops_t_dp_cost_on") == on["flops"]
+
+
+# -- roofline math -------------------------------------------------------
+
+def test_roofline_units():
+    caps = {"peak_flops": 1e12, "peak_bytes_per_sec": 1e11}
+    rl = devcaps.roofline(1e9, 1e8, 0.01, caps)
+    assert rl["achieved_flops"] == pytest.approx(1e11)
+    # ideal time = max(1e9/1e12, 1e8/1e11) = 1ms; took 10ms -> 10%
+    assert rl["roofline_pct"] == pytest.approx(10.0)
+
+    mem_bound = devcaps.roofline(1e6, 1e9, 0.1, caps)
+    # memory term dominates: 1e9/1e11 = 10ms ideal over 100ms -> 10%
+    assert mem_bound["roofline_pct"] == pytest.approx(10.0)
+
+    assert devcaps.roofline(None, 1e8, 0.01, caps)["achieved_flops"] is None
+    assert devcaps.roofline(1e9, 1e8, 0.0, caps)["roofline_pct"] is None
+    none_caps = {"peak_flops": None, "peak_bytes_per_sec": None}
+    assert devcaps.roofline(1e9, 1e8, 0.01, none_caps)["roofline_pct"] is None
+
+
+def test_devcaps_env_override(monkeypatch):
+    monkeypatch.setenv(devcaps.ENV_PEAK_FLOPS, "2.5e14")
+    monkeypatch.setenv(devcaps.ENV_PEAK_BYTES, "1.5e12")
+    caps = devcaps.capabilities()
+    assert caps["peak_flops"] == pytest.approx(2.5e14)
+    assert caps["peak_bytes_per_sec"] == pytest.approx(1.5e12)
+    assert caps["source"] == "env"
+
+
+# -- serve per-bucket series at /metrics ---------------------------------
+
+def test_bucket_series_renders_valid_prometheus():
+    fn = obs.instrumented_jit(lambda v: v - 1, program="t_dp_bkt")
+    x = jnp.arange(64, dtype=jnp.float32)
+    fn(x)   # compile while disarmed
+    devprof.enable("full")
+    with devprof.bucket_scope(256):
+        fn(x)
+    fn(x)   # outside any bucket: must not land in the bucket series
+
+    snap = registry.snapshot()
+    series = "device_seconds_t_dp_bkt_bucket_256"
+    assert snap["histograms"][series]["count"] == 1
+    assert snap["histograms"]["device_seconds_t_dp_bkt"]["count"] == 2
+
+    parsed = prom.parse_text(prom.render(snap))
+    fam = prom.metric_name(series)
+    hist = prom.histogram_series(parsed, fam)
+    assert hist and hist["count"] == 1
+
+
+def test_bucket_scope_restores_on_exit():
+    with devprof.bucket_scope(128):
+        with devprof.bucket_scope(512):
+            assert devprof._tls.bucket == 512
+        assert devprof._tls.bucket == 128
+    assert devprof._tls.bucket is None
+
+
+# -- round decomposition -------------------------------------------------
+
+def test_round_scope_partitions_wall_time(monkeypatch):
+    monkeypatch.setattr(devprof, "time", _FakeClock())
+    devprof.enable("full")
+    h0 = (obs.get_histogram("devprof_round_device_seconds") or {})
+    n0 = h0.get("count", 0)
+    fn = obs.instrumented_jit(lambda v: v * 7, program="t_dp_round")
+    with devprof.round_scope():
+        fn(jnp.arange(8, dtype=jnp.float32))
+    hd = obs.get_histogram("devprof_round_device_seconds")
+    hh = obs.get_histogram("devprof_round_host_seconds")
+    assert hd["count"] == n0 + 1 and hh["count"] >= 1
+    # fake clock: round wall == 3 ticks (one inter-read tick + sampled
+    # dispatch dt 1.0); device est 1.0 clamps inside [0, wall]
+    assert 0.0 <= hd["sum"] <= hh["sum"] + hd["sum"]
+
+
+def test_round_scope_off_is_noop():
+    n0 = obs.get_counter("devprof_rounds_total")
+    with devprof.round_scope():
+        pass
+    assert obs.get_counter("devprof_rounds_total") == n0
+
+
+# -- transfer accounting -------------------------------------------------
+
+def test_transfer_bumps_legacy_and_per_phase_names():
+    before = _counters("host_to_device_bytes", "h2d_bytes_total",
+                       "h2d_bytes_serve", "device_to_host_bytes",
+                       "d2h_bytes_total")
+    devprof.transfer("h2d", "serve", 4096, transfers=2)
+    devprof.transfer("d2h", "serve", 512)
+    after = _counters("host_to_device_bytes", "h2d_bytes_total",
+                      "h2d_bytes_serve", "device_to_host_bytes",
+                      "d2h_bytes_total")
+    assert tuple(a - b for a, b in zip(after, before)) == (
+        4096, 4096, 4096, 512, 512)
+    with pytest.raises(ValueError):
+        devprof.transfer("sideways", "serve", 1)
+
+
+# -- bench_regress --program-threshold -----------------------------------
+
+def _bench_result(value, programs=None):
+    res = {"metric": "rows_per_sec", "value": value, "unit": "rows/s"}
+    if programs is not None:
+        res["profile"] = {"mode": "sample:4", "rounds": 8,
+                          "device_seconds_est_total": sum(
+                              p["device_seconds_est"]
+                              for p in programs.values()),
+                          "programs": programs}
+        res["device"] = {"platform": "cpu", "device_kind": "cpu",
+                         "jax_version": "x"}
+    return res
+
+
+def test_bench_regress_program_threshold_gates():
+    from tools.bench_regress import compare
+    base = _bench_result(1000.0, {
+        "train_step": {"device_seconds_est": 0.8},
+        "grow_tree": {"device_seconds_est": 0.2}})
+    cand = _bench_result(1010.0, {
+        "train_step": {"device_seconds_est": 0.82},
+        "grow_tree": {"device_seconds_est": 0.48}})   # +140%
+
+    v = compare(base, cand, 10.0, program_threshold_pct=25.0)
+    assert v["ok"] is False and v["programs_ok"] is False
+    assert v["programs_delta"]["grow_tree"]["ok"] is False
+    assert v["programs_delta"]["grow_tree"]["delta_pct"] == pytest.approx(
+        140.0)
+    assert v["programs_delta"]["train_step"]["ok"] is True
+
+    wide = compare(base, cand, 10.0, program_threshold_pct=200.0)
+    assert wide["ok"] is True and wide["programs_ok"] is True
+
+
+def test_bench_regress_old_baselines_unaffected():
+    from tools.bench_regress import compare
+    old = _bench_result(1000.0)                      # pre-r16: no profile
+    cand = _bench_result(1010.0, {
+        "train_step": {"device_seconds_est": 5.0}})
+
+    v = compare(old, cand, 10.0, program_threshold_pct=25.0)
+    assert v["ok"] is True and v["programs_ok"] is True
+    assert "programs_note" in v and "baseline" in v["programs_note"]
+    # informational passthrough rides only on the side that has it
+    assert "profile_candidate" in v and "profile_baseline" not in v
+
+    # without the flag the verdict carries no per-program keys at all
+    plain = compare(old, cand, 10.0)
+    assert "programs_ok" not in plain and "programs_delta" not in plain

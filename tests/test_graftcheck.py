@@ -658,6 +658,95 @@ def test_resource_skips_unjudgeable_modes(tmp_path):
     assert report.findings == [], report.findings
 
 
+# -- family: timing ------------------------------------------------------
+
+def test_timing_async_dispatch_trips(tmp_path):
+    # the seeded bug: wall-clocking a bare jit call measures enqueue
+    # time (async dispatch), not execution — both the decorated and the
+    # module-level-assigned spellings must trip
+    root = _tree(tmp_path, {"tm.py": """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        apply = jax.jit(lambda x: x + 1)
+
+        def benchmark(x):
+            t0 = time.perf_counter()
+            y = step(x)
+            return time.perf_counter() - t0, y
+
+        def benchmark2(x):
+            start = time.monotonic()
+            y = apply(x)
+            dt = time.monotonic() - start
+            return dt, y
+    """})
+    report = run_checks(root, families=["timing"])
+    hits = [f for f in report.findings if f.rule == "timing-async-dispatch"]
+    assert len(hits) == 2, report.findings
+    assert all("enqueue" in f.message for f in hits)
+
+
+def test_timing_synced_window_passes(tmp_path):
+    # any sync marker inside the window legitimizes the measurement:
+    # block_until_ready, .item(), np.asarray, or a devprof helper
+    root = _tree(tmp_path, {"ok.py": """
+        import time
+        import jax
+        import numpy as np
+        from .obs import devprof
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def timed_sync(x):
+            t0 = time.perf_counter()
+            y = jax.block_until_ready(step(x))
+            return time.perf_counter() - t0, y
+
+        def timed_materialize(x):
+            t0 = time.perf_counter()
+            y = np.asarray(step(x))
+            return time.perf_counter() - t0, y
+
+        def timed_devprof(x):
+            t0 = time.perf_counter()
+            y = step(x)
+            devprof.sync(y, source="bench")
+            return time.perf_counter() - t0, y
+
+        def untimed(x):
+            return step(x)
+    """})
+    report = run_checks(root, families=["timing"])
+    assert report.findings == [], report.findings
+
+
+def test_timing_suppression_round_trips(tmp_path):
+    root = _tree(tmp_path, {"tm.py": """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x
+
+        def dispatch_latency(x):
+            # dispatch latency IS the quantity under test here
+            t0 = time.perf_counter()
+            step(x)
+            return time.perf_counter() - t0  # graftcheck: disable=timing-async-dispatch
+    """})
+    report = run_checks(root, families=["timing"])
+    assert report.findings == []
+    assert [f.rule for f in report.suppressed] == ["timing-async-dispatch"]
+
+
 # -- the repo itself -----------------------------------------------------
 
 def test_repo_is_clean():

@@ -49,6 +49,20 @@ and are never required — old baselines keep comparing.  Round 9 adds an
 ``availability`` block the same way (``serve_retries_total`` /
 ``serve_ejections_total`` / ``serve_deadline_expired_total`` deltas over
 the bench run): informational, never gated, never required.
+
+``--program-threshold <pct>`` gates PER-PROGRAM device seconds from the
+``profile`` block (PR 16, obs/devprof.py): for every XLA program present
+on both sides with a positive baseline ``device_seconds_est``, the
+candidate may exceed the baseline by at most that many percent — the
+instrument ROADMAP item 1's fused-vs-ordered A/B needs ("the end-to-end
+rate held, but grow_tree got 40% slower" fails loudly instead of hiding
+inside the aggregate).  Both bench runs must profile (run with
+LIGHTGBM_TPU_DEVPROF=sample:N; sampling correction makes estimates
+comparable across different N).  When either side carries no profiled
+programs — every pre-r16 baseline — the per-program gate records a note
+and passes: old baselines keep comparing, exactly like the other
+informational blocks, and the ``profile``/``device`` summaries ride
+along per side when present.
 """
 
 from __future__ import annotations
@@ -96,11 +110,15 @@ def extract_result(path: str) -> Dict[str, Any]:
 
 def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
             threshold_pct: float,
-            warmup_threshold_pct: Optional[float] = None) -> Dict[str, Any]:
+            warmup_threshold_pct: Optional[float] = None,
+            program_threshold_pct: Optional[float] = None) -> Dict[str, Any]:
     """Verdict dict; ``ok`` is False when the candidate regressed more
-    than ``threshold_pct`` percent below the baseline value, or (with a
+    than ``threshold_pct`` percent below the baseline value, (with a
     warmup threshold) when its warmup exceeds the baseline's by more
-    than ``warmup_threshold_pct`` percent."""
+    than ``warmup_threshold_pct`` percent, or (with a program threshold)
+    when any program's estimated device seconds grew by more than
+    ``program_threshold_pct`` percent — skipped with a note when either
+    side carries no profiled programs."""
     if baseline.get("metric") != candidate.get("metric"):
         raise ValueError(
             f"metric mismatch: baseline {baseline.get('metric')!r} vs "
@@ -155,6 +173,38 @@ def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
             if obj.get("warmup_warm_s") is not None:
                 verdict[f"warmup_warm_{side}_s"] = float(obj["warmup_warm_s"])
         verdict["ok"] = verdict["ok"] and verdict["warmup_ok"]
+    if program_threshold_pct is not None:
+        bp = (baseline.get("profile") or {}).get("programs") or {}
+        cp = (candidate.get("profile") or {}).get("programs") or {}
+        deltas: Dict[str, Any] = {}
+        progs_ok = True
+        for prog in sorted(set(bp) & set(cp)):
+            b = (bp[prog] or {}).get("device_seconds_est")
+            c = (cp[prog] or {}).get("device_seconds_est")
+            if b is None or c is None or float(b) <= 0:
+                continue
+            d = (float(c) - float(b)) / float(b) * 100.0
+            ok = d <= float(program_threshold_pct)
+            deltas[prog] = {"baseline_s": round(float(b), 6),
+                            "candidate_s": round(float(c), 6),
+                            "delta_pct": round(d, 3), "ok": ok}
+            progs_ok = progs_ok and ok
+        verdict["program_threshold_pct"] = float(program_threshold_pct)
+        verdict["programs_delta"] = deltas
+        if not bp or not cp:
+            # pre-r16 BENCH files (or runs with devprof off) carry no
+            # profiled programs — the gate must not fail them, or every
+            # historical baseline stops comparing; record WHY it passed
+            missing = [s for s, p in (("baseline", bp),
+                                      ("candidate", cp)) if not p]
+            verdict["programs_ok"] = True
+            verdict["programs_note"] = (
+                f"profile programs missing on {' and '.join(missing)} — "
+                f"per-program gate skipped (run bench with "
+                f"LIGHTGBM_TPU_DEVPROF to gate)")
+        else:
+            verdict["programs_ok"] = progs_ok
+            verdict["ok"] = verdict["ok"] and progs_ok
     # informational: the serving-fleet scaling curve (round 8's
     # ``bench.py --mode predict --concurrency N`` adds ``fleet`` /
     # ``concurrency`` keys) rides along in the verdict per side when
@@ -205,6 +255,25 @@ def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
                 verdict[f"{key}_{side}"] = blk
         if obj.get("auc") is not None:
             verdict[f"auc_{side}"] = float(obj["auc"])
+        # PR 16: device-time attribution summary + hardware identity
+        # (bench.py `profile`/`device` blocks) — informational per side;
+        # the gated view lives under programs_delta when
+        # --program-threshold is given
+        prof = obj.get("profile")
+        if isinstance(prof, dict) and prof:
+            verdict[f"profile_{side}"] = {
+                "mode": prof.get("mode"),
+                "device_seconds_est_total":
+                    prof.get("device_seconds_est_total"),
+                "rounds": prof.get("rounds"),
+            }
+        dev = obj.get("device")
+        if isinstance(dev, dict) and dev:
+            verdict[f"device_{side}"] = {
+                "platform": dev.get("platform"),
+                "device_kind": dev.get("device_kind"),
+                "jax_version": dev.get("jax_version"),
+            }
     return verdict
 
 
@@ -221,11 +290,17 @@ def main(argv=None) -> int:
     ap.add_argument("--warmup-threshold", type=float, default=None,
                     help="also gate warmup_s: allowed warmup INCREASE in "
                          "percent over the baseline (off by default)")
+    ap.add_argument("--program-threshold", type=float, default=None,
+                    help="also gate per-program device seconds from the "
+                         "profile block: allowed INCREASE in percent per "
+                         "XLA program (off by default; skipped with a "
+                         "note when either side has no profile data)")
     args = ap.parse_args(argv)
     try:
         verdict = compare(extract_result(args.baseline),
                           extract_result(args.candidate), args.threshold,
-                          warmup_threshold_pct=args.warmup_threshold)
+                          warmup_threshold_pct=args.warmup_threshold,
+                          program_threshold_pct=args.program_threshold)
     except (OSError, ValueError, KeyError, json.JSONDecodeError) as exc:
         print(f"bench_regress: {exc}", file=sys.stderr)
         return 2
@@ -236,6 +311,15 @@ def main(argv=None) -> int:
                   f"{verdict['warmup_candidate_s']:g}s vs baseline "
                   f"{verdict['warmup_baseline_s']:g}s "
                   f"(threshold +{args.warmup_threshold:g}%)",
+                  file=sys.stderr)
+        if not verdict.get("programs_ok", True):
+            worst = max(
+                (d for d in verdict.get("programs_delta", {}).items()
+                 if not d[1]["ok"]),
+                key=lambda d: d[1]["delta_pct"])
+            print(f"bench_regress: PROGRAM REGRESSION {worst[0]} "
+                  f"{worst[1]['delta_pct']:+.2f}% device time "
+                  f"(threshold +{args.program_threshold:g}%)",
                   file=sys.stderr)
         if verdict["delta_pct"] < -args.threshold:
             print(f"bench_regress: REGRESSION {verdict['delta_pct']:+.2f}% "

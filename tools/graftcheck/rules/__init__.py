@@ -20,8 +20,11 @@ phases     phase-taxonomy     host/device phase taxonomy in sync
 params     param-docs         config params documented + rendered
 resource   resource-raw-open  write-mode open() routes through
                               utils/diskguard.py (disk-full-safe sinks)
+timing     timing-async-      no clock deltas around bare jit dispatch
+           dispatch           (async dispatch measures enqueue, not
+                              execution — sync or route via devprof)
 ========== ================== ==========================================
 """
 
 from . import (ingress, jit, lifecycle, locks, params,  # noqa: F401
-               phases, resource, tracer)
+               phases, resource, timing, tracer)

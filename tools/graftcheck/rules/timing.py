@@ -1,0 +1,126 @@
+"""Async-dispatch timing rule (family ``timing``).
+
+- ``timing-async-dispatch`` — a ``time.perf_counter()`` /
+  ``time.monotonic()`` delta window that contains a call to a
+  known-jitted callable with no synchronization in between.  JAX
+  dispatch is asynchronous: the wall clock around a bare jit call
+  measures *enqueue* time, not device execution, so the resulting
+  "timing" silently reports microseconds for milliseconds of work.
+  The window must contain a sync marker — ``block_until_ready`` /
+  ``device_get`` / ``.item()`` / ``np.asarray`` / anything routed
+  through ``obs.devprof`` (whose ``sync``/``timed_dispatch`` helpers
+  exist precisely so timed code has one audited sync path).
+
+Known-jitted callables are resolved per module: names bound at module
+level from ``jax.jit(...)`` / ``instrumented_jit(...)`` (including the
+``obs.instrumented_jit`` spelling), and functions decorated with
+either.  Calls through attributes (``self._fn(...)``) are out of scope
+— the in-package dispatch seam (``obs/compile_ledger.py``) owns those
+and already syncs via devprof.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, Project, family
+from ..index import dotted
+
+# module-clock expressions that start/stop a timing window
+_TIMER_CALLS = {"time.perf_counter", "time.monotonic",
+                "perf_counter", "monotonic"}
+
+# jit-producing callables (last dotted segment)
+_JIT_MAKERS = {"jit", "instrumented_jit", "InstrumentedJit"}
+
+# attribute calls that force device completion inside a window
+_SYNC_ATTRS = {"block_until_ready", "device_get", "item", "sync",
+               "asarray", "timed_dispatch"}
+_SYNC_DOTTED_PREFIXES = ("devprof.", "obs.devprof.")
+
+
+def _is_timer_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and (dotted(node.func) or "") in _TIMER_CALLS)
+
+
+def _jit_names(tree: ast.Module) -> Set[str]:
+    """Module-level names that are jitted callables."""
+    names: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            d = dotted(node.value.func) or ""
+            if d.split(".")[-1] in _JIT_MAKERS:
+                names.add(node.targets[0].id)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            d = dotted(target) or ""
+            if d.split(".")[-1] in _JIT_MAKERS:
+                names.add(node.name)
+    return names
+
+
+def _is_sync(node: ast.Call) -> bool:
+    d = dotted(node.func) or ""
+    if d.startswith(_SYNC_DOTTED_PREFIXES):
+        return True
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _SYNC_ATTRS:
+        return True
+    return False
+
+
+def _scan_function(m, fn: ast.AST, jits: Set[str],
+                   findings: List[Finding]) -> None:
+    starts: Dict[str, int] = {}
+    deltas: List[Tuple[str, int]] = []
+    jit_calls: List[Tuple[str, int]] = []
+    syncs: List[int] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and _is_timer_call(node.value):
+            starts[node.targets[0].id] = node.lineno
+        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub) \
+                and isinstance(node.right, ast.Name):
+            deltas.append((node.right.id, node.lineno))
+        elif isinstance(node, ast.Call):
+            if _is_sync(node):
+                syncs.append(node.lineno)
+            elif isinstance(node.func, ast.Name) and node.func.id in jits:
+                jit_calls.append((node.func.id, node.lineno))
+    for var, end in deltas:
+        start = starts.get(var)
+        if start is None or end <= start:
+            continue
+        hit = next((j for j in jit_calls if start < j[1] <= end), None)
+        if hit is None:
+            continue
+        if any(start < s <= end for s in syncs):
+            continue
+        findings.append(Finding(
+            "timing-async-dispatch", m.rel, end,
+            f"clock delta over `{var}` spans a call to jitted "
+            f"`{hit[0]}` (line {hit[1]}) with no sync — JAX dispatch "
+            f"is async, so this measures enqueue time, not execution; "
+            f"block_until_ready the result or route through "
+            f"obs.devprof"))
+
+
+@family("timing")
+def check_timing(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in project.modules:
+        jits = _jit_names(m.tree)
+        if not jits:
+            continue
+        for node in ast.walk(m.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _scan_function(m, node, jits, findings)
+    return findings

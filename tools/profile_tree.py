@@ -1,109 +1,63 @@
-"""Device-op profile of the ordered grower at 1M rows.
+"""Per-program device-time profile of a small training run.
 
-Traces a few in-loop iterations with jax.profiler and aggregates device op
-durations from the generated perfetto trace — the ground-truth replacement
-for stub ablations (which perturb control flow) and standalone microbenches
-(which axon's dispatch replay cache poisons).
+Thin driver over the repo's own profiling path (``obs/devprof.py``, PR
+16): arms devprof, trains a few boosting rounds through the public
+``lgb.train`` surface, and renders the same table ``obs-report
+--profile`` produces — per-program estimated device seconds with
+roofline counters, the per-round host/device split, and transfer
+volumes.  This replaced a one-off ``jax.profiler`` perfetto-trace
+aggregator so there is exactly ONE profiling path to maintain; for
+kernel-level op names beyond the program granularity, use
+``jax.profiler.trace`` + perfetto directly.
+
+Environment knobs::
+
+    PROF_ROWS=200000 PROF_ROUNDS=20 PROF_DEVPROF=sample:4 \
+        python tools/profile_tree.py
+
+``PROF_DEVPROF`` defaults to ``full`` (every dispatch synced — highest
+fidelity, fine for a profiling one-shot); use ``sample:N`` to measure
+the production sampling mode itself.  ``LIGHTGBM_TPU_DEVPROF`` still
+wins over everything, as everywhere.
 """
 
-import glob
-import gzip
-import json
 import os
 import sys
-import time
-from collections import defaultdict
 
 import numpy as np
-import jax
-import jax.numpy as jnp
 
 sys.path.insert(0, ".")
 
-from lightgbm_tpu.ops.grow import GrowParams  # noqa: E402
-from lightgbm_tpu.ops.ordered_grow import grow_tree_ordered, pack_u8_words  # noqa: E402
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.obs import devprof, report  # noqa: E402
 
-N = int(os.environ.get("PROF_ROWS", 1 << 20))
-F, B, L = 28, 255, 63
-TRACE_DIR = "/tmp/jaxtrace"
+N = int(os.environ.get("PROF_ROWS", 100_000))
+F = int(os.environ.get("PROF_FEATURES", 28))
+ROUNDS = int(os.environ.get("PROF_ROUNDS", 10))
+MODE = os.environ.get("PROF_DEVPROF", "full")
 
 
 def main():
     rng = np.random.RandomState(0)
     # mildly informative features so splits are realistic (not uniform)
-    X = rng.normal(size=(N, 4)).astype(np.float32)
+    X = rng.normal(size=(N, F)).astype(np.float32)
     logit = X[:, 0] - 0.5 * X[:, 1] + rng.normal(scale=1.5, size=N)
-    y = jnp.asarray((logit > 0).astype(np.float32))
-    binsm = rng.randint(0, B, size=(N, F)).astype(np.uint8)
-    binsm[:, 0] = np.clip((X[:, 0] + 4) * 32, 0, B - 1).astype(np.uint8)
-    binsm[:, 1] = np.clip((X[:, 1] + 4) * 32, 0, B - 1).astype(np.uint8)
-    bins_rm = jnp.asarray(binsm)
-    bins = bins_rm.T
-    bins_words = jax.jit(pack_u8_words)(bins_rm)
-    num_bin = jnp.full((F,), B, jnp.int32)
-    is_cat = jnp.zeros((F,), bool)
-    feat_mask = jnp.ones((F,), bool)
-    w = jnp.ones((N,), jnp.float32)
-    params = GrowParams(num_leaves=L, max_bin=B, min_data_in_leaf=50,
-                        min_sum_hessian_in_leaf=1e-3)
+    y = (logit > 0).astype(np.float32)
 
-    @jax.jit
-    def grads(score):
-        p = jax.nn.sigmoid(score)
-        return p - y, p * (1 - p)
+    params = {
+        "objective": "binary",
+        "num_leaves": 63,
+        "learning_rate": 0.1,
+        "verbosity": -1,
+        "devprof": MODE,   # LIGHTGBM_TPU_DEVPROF env still wins
+    }
+    booster = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=ROUNDS)
+    booster.predict(X[:4096])
 
-    def one(score):
-        g, h = grads(score)
-        _, _, delta = grow_tree_ordered(bins, num_bin, is_cat, feat_mask,
-                                        g, h, w, jnp.float32(0.1), params,
-                                        bins_rm=bins_rm,
-                                        bins_words=bins_words)
-        return score + delta
-
-    score = jnp.zeros(N, jnp.float32)
-    t0 = time.time()
-    for _ in range(3):
-        score = one(score)
-    jax.block_until_ready(score)
-    print(f"warm 3 iters: {time.time() - t0:.1f}s")
-
-    t0 = time.time()
-    for _ in range(5):
-        score = one(score)
-    jax.block_until_ready(score)
-    print(f"steady: {(time.time() - t0) / 5 * 1e3:.1f} ms/tree")
-
-    os.system(f"rm -rf {TRACE_DIR}")
-    jax.profiler.start_trace(TRACE_DIR)
-    for _ in range(3):
-        score = one(score)
-    jax.block_until_ready(score)
-    jax.profiler.stop_trace()
-
-    files = glob.glob(f"{TRACE_DIR}/**/*.trace.json.gz", recursive=True)
-    print("trace files:", files)
-    agg = defaultdict(float)
-    cnt = defaultdict(int)
-    total = 0.0
-    for f in files:
-        with gzip.open(f, "rt") as fh:
-            data = json.load(fh)
-        for ev in data.get("traceEvents", []):
-            if ev.get("ph") != "X":
-                continue
-            pid_name = ev.get("pid")
-            name = ev.get("name", "")
-            dur = ev.get("dur", 0) / 1e3  # ms
-            cat = ev.get("args", {})
-            # keep device lanes only (XLA Ops)
-            tid = ev.get("tid", 0)
-            if "tf_op" in cat or name.startswith("fusion") or True:
-                agg[name[:80]] += dur
-                cnt[name[:80]] += 1
-    top = sorted(agg.items(), key=lambda kv: -kv[1])[:45]
-    for name, ms in top:
-        print(f"{ms:10.2f} ms  x{cnt[name]:5d}  {name}")
+    print(report.render_profile_table(report.profile_summary(top_k=12)))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
