@@ -9,6 +9,10 @@ DESC = {
     "valid_data": "validation data file path(s), comma separated",
     "num_iterations": "number of boosting rounds",
     "learning_rate": "shrinkage rate",
+    "shrinkage_decay": "default decay in (0, 1] applied to the merged "
+                       "model's leaf outputs in Booster.merge (1 = "
+                       "verbatim; the train->serve->retrain loop's "
+                       "delta-forest damping)",
     "num_leaves": "max leaves per tree (leaf-wise growth)",
     "tree_learner": "serial | feature | data | voting — distributed learner "
                     "over the device mesh",
@@ -70,6 +74,35 @@ DESC = {
                         "model per slot after each successful reload; a "
                         "restarted server boots it instead of "
                         "input_model (crash restore)",
+    "serve_shadow": "task=serve: fraction of primary traffic mirrored "
+                    "onto the canary OFF the response path (bounded "
+                    "queue, dropped under load — never sheds or slows "
+                    "real requests; serve/lifecycle.py, "
+                    "docs/FAULT_TOLERANCE.md §Model lifecycle)",
+    "lifecycle_window_s": "task=serve: guarded-promotion observation "
+                          "window after a canary reload — the "
+                          "PromotionController ends it in promote / "
+                          "rollback / extend (0 disables the guarded "
+                          "lifecycle)",
+    "lifecycle_max_window_s": "task=serve: hard cap on the extended "
+                              "observation window; a candidate still "
+                              "unproven at the cap is rolled back, "
+                              "never promoted by timeout (0 = 4x "
+                              "lifecycle_window_s)",
+    "lifecycle_min_samples": "task=serve: canary requests each guardrail "
+                             "gate needs in the window before it may "
+                             "vote (promote or rollback)",
+    "lifecycle_latency_ratio": "task=serve: rollback when windowed "
+                               "canary p99 latency exceeds this multiple "
+                               "of the primary's (0 disables the "
+                               "latency gate)",
+    "lifecycle_error_rate": "task=serve: rollback when the canary's "
+                            "windowed (errors + ejections) / requests "
+                            "exceeds this rate",
+    "lifecycle_cooldown_s": "task=serve: sticky cooldown after a "
+                            "rollback — a re-reloaded candidate inside "
+                            "it is rolled back immediately; doubles per "
+                            "consecutive rollback (0 = none)",
     "serve_max_body_bytes": "task=serve: request body size cap — larger "
                             "payloads are shed with 413 before any "
                             "parsing or device time (0 = no cap)",

@@ -16,7 +16,7 @@ from .callback import (early_stopping, log_telemetry,  # noqa: F401
                        print_evaluation, record_evaluation, reset_parameter)
 from . import obs  # noqa: F401
 from . import serve  # noqa: F401
-from .engine import CVBooster, cv, train  # noqa: F401
+from .engine import CVBooster, cv, train, train_delta  # noqa: F401
 from .sklearn import (LGBMClassifier, LGBMModel,  # noqa: F401
                       LGBMRanker, LGBMRegressor)
 from .utils.log import LightGBMError  # noqa: F401
@@ -28,7 +28,7 @@ except ImportError:  # matplotlib not installed
     _PLOTTING = []
 
 __all__ = ["Dataset", "Booster", "Config",
-           "train", "cv", "CVBooster",
+           "train", "train_delta", "cv", "CVBooster",
            "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
            "print_evaluation", "record_evaluation", "reset_parameter",
            "early_stopping", "log_telemetry", "obs", "serve",

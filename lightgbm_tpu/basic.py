@@ -611,6 +611,28 @@ class Booster:
             "tree_info": [b.models[i].to_json() for i in range(n_models)],
         }
 
+    def merge(self, other: "Booster",
+              shrinkage_decay: Optional[float] = None) -> "Booster":
+        """Append ``other``'s trees to this booster (Boosting::MergeFrom)
+        with their leaf outputs scaled by ``shrinkage_decay`` — raw
+        scores are additive, so the merged model predicts exactly
+        ``base + decay * delta``.  Defaults to the ``shrinkage_decay``
+        param (1.0 = plain merge).  Refuses incompatible merges
+        (num_class / feature width / objective) with a named
+        LightGBMError; ``other`` is never modified.  Returns self."""
+        if not isinstance(other, Booster):
+            raise TypeError(
+                f"Booster.merge expects a Booster, got {type(other).__name__}")
+        if shrinkage_decay is None:
+            shrinkage_decay = float(
+                getattr(self.config, "shrinkage_decay", 1.0))
+        self._booster.merge_from(other._booster,
+                                 shrinkage_decay=float(shrinkage_decay))
+        # drop stale compiled-forest snapshots — the model just grew
+        self._compiled = None
+        self._auto_forest = None
+        return self
+
     # -- prediction ------------------------------------------------------
     _PREDICT_CHUNK_ROWS = 1 << 16
 

@@ -561,11 +561,13 @@ def LGBM_BoosterFree(handle):
 @_capi
 def LGBM_BoosterMerge(handle, other_handle):
     """Append other's trees (GBDT::MergeFrom, gbdt.cpp:90-99: models are
-    merged; score updaters are deliberately left untouched)."""
+    merged; score updaters are deliberately left untouched).  Routed
+    through the validated merge so incompatible boosters (num_class /
+    feature width / objective) refuse with a named error instead of
+    silently corrupting predictions."""
     cb = _from_handle(handle)
     other = _from_handle(other_handle)
-    cb.b.models.extend(other.b.models)
-    cb.b.iter_ = len(cb.b.models) // max(cb.b.num_class, 1)
+    cb.b.merge_from(other.b)
 
 
 @_capi

@@ -256,6 +256,25 @@ _DEFAULTS: Dict[str, Any] = {
     "serve_stall_ms": 5000.0,   # device-batch stall age -> replica wedged
     "serve_latency_outlier": 8.0,  # EWMA multiple of fleet median -> suspect
     "serve_state_file": "",     # last-good model state JSON (crash restore)
+    # guarded model lifecycle (serve/lifecycle.py; docs/FAULT_TOLERANCE.md
+    # §Model lifecycle): canary observation window + guardrails
+    "serve_shadow": 0.0,        # fraction of primary traffic mirrored onto
+                                # the canary off the response path [0, 1]
+    "lifecycle_window_s": 0.0,  # canary observation window before a
+                                # promote/rollback verdict (0 = manual
+                                # promotion, controller off)
+    "lifecycle_max_window_s": 0.0,  # hard cap on extended windows
+                                    # (0 = 4x lifecycle_window_s)
+    "lifecycle_min_samples": 50,  # canary requests a guardrail needs
+                                  # before it may vote
+    "lifecycle_latency_ratio": 3.0,  # canary p99 / primary p99 above this
+                                     # -> rollback (0 = gate off)
+    "lifecycle_error_rate": 0.05,  # canary error+ejection share above
+                                   # this -> rollback
+    "lifecycle_cooldown_s": 60.0,  # post-rollback cooldown base, doubling
+                                   # per consecutive rollback
+    "shrinkage_decay": 1.0,     # leaf-output decay Booster.merge applies
+                                # to the donor's trees (1.0 = plain merge)
     # serve ingress hardening (serve/server.py; docs/FAULT_TOLERANCE.md)
     "serve_max_body_bytes": 33554432,  # request body cap -> 413 (0 = none)
     "serve_nonfinite_policy": "reject",  # reject | propagate NaN/Inf
@@ -499,9 +518,11 @@ class Config:
         if not (0.0 <= v["serve_canary_weight"] < 1.0):
             raise ValueError("serve_canary_weight must be in [0, 1) — the "
                              "canary is a minority share, not the primary")
-        if v["serve_canary_weight"] > 0 and not v["serve_canary_model"]:
-            raise ValueError("serve_canary_weight > 0 needs a "
-                             "serve_canary_model file to route to")
+        # serve_canary_weight > 0 with no serve_canary_model is valid:
+        # it reserves an EMPTY canary slot that a later
+        # ``POST /reload {"target": "canary"}`` fills (the guarded
+        # promotion flow, serve/lifecycle.py) — routing only splits
+        # traffic once a canary is actually live
         if v["serve_retry_limit"] < 0:
             raise ValueError("serve_retry_limit must be >= 0 "
                              "(0 disables hedged retries)")
@@ -516,6 +537,35 @@ class Config:
         if v["serve_latency_outlier"] <= 1.0:
             raise ValueError("serve_latency_outlier must be > 1 — it "
                              "multiplies the fleet-median service time")
+        if not (0.0 <= v["serve_shadow"] <= 1.0):
+            raise ValueError("serve_shadow must be in [0, 1] — the "
+                             "fraction of primary traffic mirrored onto "
+                             "the canary")
+        if v["lifecycle_window_s"] < 0:
+            raise ValueError("lifecycle_window_s must be >= 0 "
+                             "(0 = manual promotion, controller off)")
+        if v["lifecycle_max_window_s"] < 0:
+            raise ValueError("lifecycle_max_window_s must be >= 0 "
+                             "(0 = 4x lifecycle_window_s)")
+        if v["lifecycle_max_window_s"] > 0 \
+                and v["lifecycle_max_window_s"] < v["lifecycle_window_s"]:
+            raise ValueError("lifecycle_max_window_s must be >= "
+                             "lifecycle_window_s (or 0 for the 4x default)")
+        if v["lifecycle_min_samples"] < 1:
+            raise ValueError("lifecycle_min_samples must be >= 1 — a "
+                             "guardrail must never vote on zero evidence")
+        if v["lifecycle_latency_ratio"] != 0 \
+                and v["lifecycle_latency_ratio"] <= 1.0:
+            raise ValueError("lifecycle_latency_ratio must be > 1 (it "
+                             "multiplies the primary's p99) or 0 to "
+                             "disable the latency gate")
+        if not (0.0 <= v["lifecycle_error_rate"] <= 1.0):
+            raise ValueError("lifecycle_error_rate must be in [0, 1]")
+        if v["lifecycle_cooldown_s"] < 0:
+            raise ValueError("lifecycle_cooldown_s must be >= 0")
+        if not (0.0 < v["shrinkage_decay"] <= 1.0):
+            raise ValueError("shrinkage_decay must be in (0, 1] — 0 would "
+                             "merge dead trees, > 1 would amplify them")
         # devprof mode grammar is owned by obs/devprof.parse_mode — a
         # typo'd value must die here, not silently disable profiling
         from .obs.devprof import parse_mode as _devprof_parse

@@ -318,6 +318,20 @@ def train(params, train_set, num_boost_round=100,
     return booster
 
 
+def train_delta(base_model, fresh_data, num_trees=100, params=None,
+                **kwargs):
+    """Warm-start retrain for the serve→retrain loop (docs/SERVING.md
+    §Promotion): boost ``num_trees`` new rounds on ``fresh_data`` on top
+    of ``base_model`` (a Booster or model-file path) via the
+    ``init_model`` path.  The base trees are carried over untouched —
+    the returned booster's first ``base.num_trees()`` trees bit-match
+    the base model — so the delta can be evaluated, merged
+    (``Booster.merge``), or served as a canary candidate on its own."""
+    return train(dict(params or {}), fresh_data,
+                 num_boost_round=num_trees, init_model=base_model,
+                 **kwargs)
+
+
 class CVBooster:
     """Auxiliary data struct holding all fold boosters (engine.py:204-240)."""
 

@@ -184,6 +184,8 @@ def plan_bundles(sample: np.ndarray, mappers, used_feature_map,
         if process_rank_world()[1] > 1:
             # each rank loads its own shard: independently-drawn plans
             # would desync the replicated feature space pod-wide
+            from .. import obs
+            obs.set_gauge("efb_disabled_multihost", 1)
             log.warn_once("efb_multihost",
                           "enable_bundle: feature bundling is disabled "
                           "under multihost loading (per-rank samples "

@@ -2298,6 +2298,47 @@ class GBDT:
     def num_trees(self) -> int:
         return len(self.models)
 
+    # -- merge (Boosting::MergeFrom) -----------------------------------
+    def _merge_identity(self):
+        """(num_class, feature width, objective name) for compatibility
+        checks.  Objective name is '' when unknown (bare loaded model),
+        in which case the objective gate abstains."""
+        name = getattr(getattr(self, "objective", None), "name", "") \
+            or getattr(self, "objective_name", "")
+        if name == "none":
+            name = ""
+        return self.num_class, self.max_feature_idx, name
+
+    def merge_from(self, other: "GBDT",
+                   shrinkage_decay: float = 1.0) -> None:
+        """Append ``other``'s trees to this model with their leaf outputs
+        scaled by ``shrinkage_decay`` — Boosting::MergeFrom with decay.
+        Refuses (named LightGBMError) rather than silently corrupting
+        predictions when the two boosters are structurally incompatible."""
+        d = float(shrinkage_decay)
+        if not (0.0 < d <= 1.0) or d != d:
+            raise LightGBMError(
+                f"Cannot merge: shrinkage_decay must be in (0, 1], "
+                f"got {shrinkage_decay!r}")
+        nc_a, fw_a, obj_a = self._merge_identity()
+        nc_b, fw_b, obj_b = other._merge_identity()
+        if nc_a != nc_b:
+            raise LightGBMError(
+                f"Cannot merge: num_class mismatch "
+                f"(base={nc_a}, other={nc_b})")
+        if fw_a != fw_b:
+            raise LightGBMError(
+                f"Cannot merge: feature width mismatch "
+                f"(base max_feature_idx={fw_a}, other={fw_b})")
+        if obj_a and obj_b and obj_a != obj_b:
+            raise LightGBMError(
+                f"Cannot merge: objective mismatch "
+                f"(base={obj_a!r}, other={obj_b!r})")
+        merged = list(self.models)
+        merged.extend(t.scaled_copy(d) for t in other.models)
+        self.models = merged
+        self.iter_ = len(self.models) // max(self.num_class, 1)
+
 
 _COUNTING_FOREST_JIT = None
 

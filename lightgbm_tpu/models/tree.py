@@ -16,6 +16,7 @@ equivalent to the reference's double comparison because
 
 from __future__ import annotations
 
+import copy
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -190,6 +191,21 @@ class Tree:
                 else:
                     best = max(best, depth[node] + 1)
         return best
+
+    def scaled_copy(self, factor: float) -> "Tree":
+        """Deep copy with every leaf output scaled by ``factor`` —
+        Tree::Shrinkage applied at merge time (GBDT.merge_from's
+        ``shrinkage_decay``).  ``internal_value`` and the recorded
+        ``shrinkage`` scale with the leaves so the text serialization
+        stays self-consistent; the original tree is never touched (the
+        donor model keeps predicting exactly what it did)."""
+        t = copy.deepcopy(self)
+        f = float(factor)
+        if f != 1.0:
+            t.leaf_value = np.asarray(t.leaf_value, np.float64) * f
+            t.internal_value = np.asarray(t.internal_value, np.float64) * f
+            t.shrinkage = float(t.shrinkage) * f
+        return t
 
     # ------------------------------------------------------------------
     def to_string(self) -> str:

@@ -50,6 +50,11 @@ HOST_PHASES = frozenset({
     "Serve::hedge",       # one retried dispatch onto a different replica
     "Serve::eject",       # watchdog removing a bad replica from dispatch
     "Serve::probe",       # synthetic probe of an ejected replica
+    # guarded model lifecycle (serve/lifecycle.py)
+    "Serve::verdict",     # promotion controller ending an observation
+                          # window: promote / rollback / extend
+    "Serve::shadow",      # one mirrored batch scored on the canary off
+                          # the response path
 })
 
 DEVICE_PHASES = frozenset({

@@ -18,6 +18,12 @@
               (healthy/suspect/ejected/probation), the ejection
               watchdog and synthetic probes
               (docs/FAULT_TOLERANCE.md §Serving).
+``lifecycle`` — guarded model lifecycle: :class:`GuardrailPolicy`
+              thresholds over the labeled serve series,
+              :class:`PromotionController` (observe -> promote /
+              rollback / extend), :class:`ShadowScorer` off-path canary
+              mirroring, :class:`FeedbackTracker` label joins
+              (docs/FAULT_TOLERANCE.md §Model lifecycle).
 ``server``  — stdlib HTTP front end (``python -m lightgbm_tpu serve``).
 """
 
@@ -29,6 +35,8 @@ from .fleet import (Fleet, FleetResult, ModelManager,  # noqa: F401
 from .forest import CompiledForest  # noqa: F401
 from .health import (NoHealthyReplicas, ReplicaEjected,  # noqa: F401
                      Watchdog)
+from .lifecycle import (FeedbackTracker, GuardrailPolicy,  # noqa: F401
+                        PromotionController, ShadowScorer)
 from .server import PredictServer, serve_from_config  # noqa: F401
 
 __all__ = ["CompiledForest", "BucketLadder", "MicroBatcher", "QueueFull",
@@ -36,4 +44,6 @@ __all__ = ["CompiledForest", "BucketLadder", "MicroBatcher", "QueueFull",
            "default_ladder", "Fleet", "FleetResult", "ModelManager",
            "Overloaded", "Replica", "ReplicaSet", "fleet_devices",
            "NoHealthyReplicas", "ReplicaEjected", "Watchdog",
+           "FeedbackTracker", "GuardrailPolicy", "PromotionController",
+           "ShadowScorer",
            "PredictServer", "serve_from_config"]

@@ -70,7 +70,7 @@ import json
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -486,6 +486,9 @@ class Fleet:
         the replica suspect; the watchdog does the ejecting."""
         rep.consecutive_errors += 1
         rep.errors += 1
+        obs.inc("serve_request_errors_total")
+        obs.inc(obs.labeled_name("serve_request_errors_total",
+                                 model=rep.model))
         if rep.health == EJECTED:
             return
         if rep.health == PROBATION:
@@ -725,6 +728,41 @@ class Fleet:
         obs.inc("serve_reloads")
         return new_set
 
+    def drop_canary(self) -> bool:
+        """Detach the canary set from routing (atomic under the fleet
+        lock) and drain it off-path — the rollback half of the guarded
+        lifecycle (serve/lifecycle.py), also run after a promote so the
+        old canary batchers close.  In-flight canary requests finish on
+        the forest they started on; new traffic routes 100% primary from
+        the instant the pointer clears.  Returns False when no canary
+        was live."""
+        with self._cond:
+            old, self._canary = self._canary, None
+            self._canary_acc = 0.0
+            if old is not None:
+                self._update_health_gauge_locked()
+        if old is None:
+            return False
+        log.info("serve: canary generation %d detached from routing; "
+                 "draining", old.generation)
+        with obs.span("Serve::drain"):
+            self._drain(old)
+        obs.inc("serve_canary_dropped_total")
+        return True
+
+    def canary_snapshot(self) -> Optional[Tuple[Any, str, int]]:
+        """``(forest, model_path, generation)`` of the live canary set,
+        or None — what the lifecycle controller promotes."""
+        with self._cond:
+            rs = self._canary
+            if rs is None:
+                return None
+            return (rs.replicas[0].forest, rs.model_path, rs.generation)
+
+    def has_canary(self) -> bool:
+        with self._cond:
+            return self._canary is not None
+
     def _drain(self, rs: Optional[ReplicaSet],
                timeout_s: float = 120.0) -> None:
         """Wait out every dispatch still holding ``rs`` (they finish on
@@ -783,6 +821,10 @@ class ModelManager:
       ``input_model`` (``restore_path`` / ``serve_state_file``).
     """
 
+    # class-level fallback so a bare instance (ModelManager.__new__ in
+    # tests) can still write state; __init__ shadows it per instance
+    _state_lock = threading.Lock()
+
     def __init__(self, fleet: Fleet,
                  loader: Optional[Callable[[str], Any]] = None,
                  params: Optional[Dict[str, Any]] = None,
@@ -794,6 +836,12 @@ class ModelManager:
         self._buckets = list(buckets) if buckets else None
         self.state_file = str(state_file) if state_file else None
         self._reload_lock = threading.Lock()
+        # serializes every read-modify-write of the state file: reloads
+        # (note_good) and the lifecycle controller's verdict records
+        # (update_state/clear_slot) run on different threads and must
+        # not lose each other's slots.  Shadows the class-level fallback
+        # (which keeps bare ModelManager.__new__ test doubles safe).
+        self._state_lock = threading.Lock()
 
     def _load_model_file(self, path: str):
         from ..basic import Booster
@@ -836,26 +884,44 @@ class ModelManager:
         served ``target``.  Atomic (tmp + ``os.replace``) and
         best-effort: a state write failure warns, it never fails the
         reload that already succeeded."""
-        if not self.state_file:
-            return
-        from ..utils import diskguard
-        try:
-            state = self.read_state(self.state_file)
+        def mutate(state: Dict[str, Any]) -> None:
             state[str(target)] = {"model": str(model_path),
                                   "generation": int(generation),
                                   "t": round(time.time(), 3)}
-            # atomic + last-good (utils/diskguard.py): on a full disk
-            # the orphaned .tmp is removed and the PREVIOUS state file
-            # survives, so a restart still boots the last model that
-            # successfully recorded — and the next reload retries
-            diskguard.write_file_atomic(
-                self.state_file, json.dumps(state).encode(),
-                sink="serve_state", fsync=False)
-        except OSError as exc:
-            diskguard.note_sink_error(
-                "serve_state", self.state_file, exc,
-                action="the last-good state file is kept; the next "
-                "successful reload retries the write")
+        self._write_state(mutate)
+
+    def update_state(self, key: str, value: Any) -> None:
+        """Record an arbitrary slot in the state file (the lifecycle
+        controller persists its phase/cooldown under ``"lifecycle"``)."""
+        self._write_state(lambda state: state.__setitem__(str(key), value))
+
+    def clear_slot(self, target: str) -> None:
+        """Forget a slot.  Rollback and post-promote both clear the
+        ``canary`` entry so a restart can never resurrect an unvetted
+        model (docs/FAULT_TOLERANCE.md §Model lifecycle)."""
+        self._write_state(lambda state: state.pop(str(target), None))
+
+    def _write_state(self, mutate: Callable[[Dict[str, Any]], None]) -> None:
+        """One serialized read-modify-write of the state file."""
+        if not self.state_file:
+            return
+        from ..utils import diskguard
+        with self._state_lock:
+            try:
+                state = self.read_state(self.state_file)
+                mutate(state)
+                # atomic + last-good (utils/diskguard.py): on a full disk
+                # the orphaned .tmp is removed and the PREVIOUS state file
+                # survives, so a restart still boots the last model that
+                # successfully recorded — and the next write retries
+                diskguard.write_file_atomic(
+                    self.state_file, json.dumps(state).encode(),
+                    sink="serve_state", fsync=False)
+            except OSError as exc:
+                diskguard.note_sink_error(
+                    "serve_state", self.state_file, exc,
+                    action="the last-good state file is kept; the next "
+                    "successful write retries")
 
     @staticmethod
     def read_state(state_file: str) -> Dict[str, Any]:

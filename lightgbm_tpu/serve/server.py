@@ -23,7 +23,16 @@ Protocol (JSON in/out; CSV/TSV accepted for rows):
   zero-downtime hot swap: the new model builds and warms OFF the
   serving path, swaps in atomically, and the old generation drains
   (in-flight requests finish on the forest they started on).  Responds
-  with the new generation id once the drain completes.
+  with the new generation id once the drain completes.  With the
+  lifecycle controller enabled (``lifecycle_window_s > 0``), a
+  ``target=canary`` reload opens a guarded observation window that ends
+  in automatic promote / rollback (serve/lifecycle.py,
+  docs/FAULT_TOLERANCE.md §Model lifecycle).
+- ``POST /feedback``: body ``{"request_id": id, "label": y}`` — joins a
+  ground-truth label back to the model that served prediction ``id``
+  (the ``request_id`` echoed by ``/predict``), feeding the per-model
+  rolling logloss/AUC gauges the quality guardrail reads.  404 for an
+  unknown/expired id.
 - ``GET /healthz``: LIVENESS — process up + frozen-forest shape info +
   generation (200 even while warming or draining).
 - ``GET /readyz``: READINESS — 503 before the background warmup
@@ -74,6 +83,8 @@ from .batcher import DeadlineExpired
 from .fleet import Fleet, ModelManager, Overloaded
 from .forest import CompiledForest
 from .health import NoHealthyReplicas
+from .lifecycle import (FeedbackTracker, GuardrailPolicy,
+                        PromotionController, ShadowScorer)
 
 # monotonically increasing request ids: echoed in the X-Request-Id
 # response header and attached to each request's causal-trace root span,
@@ -245,7 +256,8 @@ class _Handler(BaseHTTPRequestHandler):
             # names (histogram series included) surface here without this
             # handler ever learning about them
             self._reply(200, {**registry_stats(),
-                              "fleet": srv.fleet.stats()}, req_id)
+                              "fleet": srv.fleet.stats(),
+                              "lifecycle": srv.lifecycle_stats()}, req_id)
         elif self.path == "/metrics":
             from ..obs import prom
             from ..obs.metrics_server import rank_labels
@@ -265,6 +277,9 @@ class _Handler(BaseHTTPRequestHandler):
         req_id = next(_request_ids)
         if self.path == "/reload":
             self._do_reload(srv, req_id)
+            return
+        if self.path == "/feedback":
+            self._do_feedback(srv, req_id)
             return
         if self.path != "/predict":
             self._reply(404, {"error": f"unknown path {self.path}"}, req_id)
@@ -351,15 +366,28 @@ class _Handler(BaseHTTPRequestHandler):
                 res = srv.fleet.submit(rows, timeout=srv.request_timeout,
                                        deadline_s=deadline_s)
                 status = 200
+                preds = _json_predictions(res.raw, res.out,
+                                          opts["raw_score"])
+                # feedback join registered BEFORE the reply bytes go
+                # out: a fast client may POST /feedback the instant it
+                # reads the response, and the pending entry must already
+                # exist (O(1), never blocks the reply)
+                if srv.feedback is not None and len(preds) == 1 \
+                        and isinstance(preds[0], float):
+                    srv.feedback.note(req_id, res.model, preds[0])
                 self._reply(200, {
-                    "predictions": _json_predictions(res.raw, res.out,
-                                                     opts["raw_score"]),
+                    "predictions": preds,
                     "num_rows": int(rows.shape[0]),
                     "request_id": req_id,
                     "model": res.model,
                     "generation": res.generation,
                     "replica": res.replica,
                 }, req_id)
+                # shadow mirroring AFTER the reply: O(1), bounded queue
+                # that drops under load — it never sheds or slows the
+                # request we just served
+                if srv.shadow is not None and res.model == "primary":
+                    srv.shadow.offer(rows)
             except Overloaded as exc:
                 # admission control shed: bend p99, don't break it.  The
                 # Retry-After hint is the observed p50 service time —
@@ -441,6 +469,11 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             try:
                 gen = srv.manager.reload(str(model), target=str(target))
+                if str(target) == "canary" and srv.controller is not None:
+                    # open the guarded observation window (or, inside
+                    # the post-rollback cooldown, roll the candidate
+                    # straight back — GET /stats names the verdict)
+                    srv.controller.begin(str(model), gen)
                 if rh is not None:
                     rh.args["status"] = 200
                 self._reply(200, {"status": "ok", "generation": gen,
@@ -458,6 +491,30 @@ class _Handler(BaseHTTPRequestHandler):
                 if rh is not None:
                     rh.args["status"] = 500
                 self._reply(500, {"error": f"reload failed: {exc}"}, req_id)
+
+    def _do_feedback(self, srv: "PredictServer", req_id: int) -> None:
+        """``POST /feedback {"request_id": id, "label": y}`` — deliver a
+        ground-truth label for a previously served prediction; feeds the
+        per-model rolling-quality gauges the lifecycle quality guardrail
+        reads.  404 for an unknown/expired request id."""
+        try:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            rid = int(payload["request_id"])
+            label = float(payload["label"])
+            if not math.isfinite(label):
+                raise ValueError("label must be finite")
+        except Exception as exc:
+            obs.inc("serve_bad_requests")
+            self._reply(400, {"error": f"bad request: feedback body must "
+                                       f"be {{\"request_id\": id, "
+                                       f"\"label\": y}} ({exc})"}, req_id)
+            return
+        if srv.feedback is None or not srv.feedback.feedback(rid, label):
+            self._reply(404, {"error": f"unknown or expired request_id "
+                                       f"{rid}"}, req_id)
+            return
+        self._reply(200, {"status": "ok", "request_id": rid}, req_id)
 
 
 class PredictServer:
@@ -479,7 +536,15 @@ class PredictServer:
                  state_file: Optional[str] = None,
                  warm_in_background: bool = False,
                  max_body_bytes: int = 33554432,
-                 nonfinite_policy: str = "reject"):
+                 nonfinite_policy: str = "reject",
+                 shadow_fraction: float = 0.0,
+                 lifecycle_window_s: float = 0.0,
+                 lifecycle_max_window_s: float = 0.0,
+                 lifecycle_min_samples: int = 50,
+                 lifecycle_latency_ratio: float = 3.0,
+                 lifecycle_error_rate: float = 0.05,
+                 lifecycle_cooldown_s: float = 60.0,
+                 lifecycle_interval_s: float = 0.25):
         # ingress hardening: request body cap (-> 413) and the NaN/Inf
         # feature policy (reject -> 400 naming the row, or propagate)
         self.max_body_bytes = max(int(max_body_bytes), 0)
@@ -514,6 +579,40 @@ class PredictServer:
         self._ready = threading.Event()
         if not self._warm_in_background:
             self._ready.set()       # caller handed us a warmed fleet
+        # guarded model lifecycle (serve/lifecycle.py): the feedback
+        # join is always on (a dict and two deques); shadow scoring and
+        # the promotion controller are built only when configured
+        self.feedback: Optional[FeedbackTracker] = FeedbackTracker()
+        self.shadow: Optional[ShadowScorer] = None
+        if float(shadow_fraction) > 0.0:
+            self.shadow = ShadowScorer(self.fleet,
+                                       fraction=float(shadow_fraction))
+        self.controller: Optional[PromotionController] = None
+        if float(lifecycle_window_s) > 0.0:
+            policy = GuardrailPolicy(
+                min_samples=int(lifecycle_min_samples),
+                latency_ratio=float(lifecycle_latency_ratio),
+                error_rate=float(lifecycle_error_rate))
+            self.controller = PromotionController(
+                self.fleet, self.manager, policy,
+                window_s=float(lifecycle_window_s),
+                max_window_s=float(lifecycle_max_window_s),
+                cooldown_s=float(lifecycle_cooldown_s),
+                feedback=self.feedback,
+                interval_s=float(lifecycle_interval_s))
+
+    def lifecycle_stats(self) -> dict:
+        """The ``GET /stats`` ``lifecycle`` block: controller phase +
+        last verdict (with its named reason), shadow queue state, and
+        per-model rolling quality."""
+        return {
+            "controller": (self.controller.stats()
+                           if self.controller is not None else None),
+            "shadow": (self.shadow.stats()
+                       if self.shadow is not None else None),
+            "quality": (self.feedback.quality()
+                        if self.feedback is not None else {}),
+        }
 
     def is_ready(self) -> bool:
         return self._ready.is_set() and not self._stop_requested.is_set()
@@ -585,6 +684,12 @@ class PredictServer:
         self.httpd.shutdown()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
+        # lifecycle daemons stop BEFORE the fleet closes: a tick or a
+        # shadow submit must never race the batcher teardown
+        if self.controller is not None:
+            self.controller.close()
+        if self.shadow is not None:
+            self.shadow.close()
         if self._warm_thread is not None and self._warm_thread.is_alive():
             # wait out the warm thread's CURRENT bucket compile (it
             # polls _stop_requested between buckets): exiting with an
@@ -715,7 +820,20 @@ def serve_from_config(config, params=None) -> PredictServer:
         max_body_bytes=int(getattr(config, "serve_max_body_bytes",
                                    33554432)),
         nonfinite_policy=str(getattr(config, "serve_nonfinite_policy",
-                                     "reject")))
+                                     "reject")),
+        shadow_fraction=float(getattr(config, "serve_shadow", 0.0)),
+        lifecycle_window_s=float(getattr(config, "lifecycle_window_s",
+                                         0.0)),
+        lifecycle_max_window_s=float(
+            getattr(config, "lifecycle_max_window_s", 0.0)),
+        lifecycle_min_samples=int(getattr(config, "lifecycle_min_samples",
+                                          50)),
+        lifecycle_latency_ratio=float(
+            getattr(config, "lifecycle_latency_ratio", 3.0)),
+        lifecycle_error_rate=float(getattr(config, "lifecycle_error_rate",
+                                           0.05)),
+        lifecycle_cooldown_s=float(getattr(config, "lifecycle_cooldown_s",
+                                           60.0)))
     # the boot model is the first last-good model: a crash before any
     # reload restores to exactly what was serving
     server.manager.note_good(model_path, generation=fleet.generation)

@@ -27,6 +27,9 @@ fragments:
   blocks until release — the classic hung-device failure),
   ``poison_predict`` (predict raises on one replica),
   ``slow_replica`` (added service latency — the straggler),
+  ``skew_predictions`` (every replica of one model returns values
+  shifted by a constant — the silently-wrong model only the lifecycle
+  quality guardrail can catch),
   ``fail_warmup`` (``CompiledForest.warmup`` raises — a hot reload
   dying mid-warm).  Each patches the replica's FOREST as well as its
   live batcher, so the health watchdog's synthetic probes see the same
@@ -393,6 +396,36 @@ def slow_replica(fleet, replica_id: int, delay_s: float,
 
     with _patched_predict(fleet, replica_id, slowed, model) as stats:
         stats["delay_s"] = float(delay_s)
+        yield stats
+
+
+@contextlib.contextmanager
+def skew_predictions(fleet, offset: float,
+                     model: str = "canary") -> Iterator[dict]:
+    """Every prediction from EVERY replica of ``model`` comes back
+    shifted by ``offset`` — the silently-wrong model (a mis-exported
+    artifact, a feature-pipeline skew) that serves fast, errors never,
+    and is purely WORSE.  Latency and error guardrails cannot see it;
+    the labeled-feedback quality gate (rolling logloss/AUC,
+    serve/lifecycle.py) is the one that must trip.  Results stay
+    shaped/typed correctly; only the values are poisoned."""
+    import numpy as np
+
+    off = float(offset)
+
+    def skewed(inner, rows):
+        raw, out = inner(rows)
+        return np.asarray(raw) + off, np.asarray(out) + off
+
+    with fleet._cond:
+        rs = fleet._primary if model == "primary" else fleet._canary
+        if rs is None:
+            raise ValueError(f"fleet has no {model!r} replica set")
+        ids = [rep.replica_id for rep in rs.replicas]
+    with contextlib.ExitStack() as stack:
+        stats = {"offset": off, "replicas": ids, "per_replica": [
+            stack.enter_context(_patched_predict(fleet, rid, skewed, model))
+            for rid in ids]}
         yield stats
 
 

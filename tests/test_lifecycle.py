@@ -1,0 +1,598 @@
+"""Guarded model lifecycle acceptance (serve/lifecycle.py).
+
+The chaos gates from the issue:
+
+- under sustained multi-threaded load, a canary poisoned with
+  ``slow_replica`` auto-rolls-back inside the observation window with
+  ZERO failed client requests; the reason is named in ``/stats``-shape
+  controller stats AND the ``Serve::verdict`` trace span, and
+  ``lifecycle_rollbacks_total`` moves by exactly 1;
+- a ``skew_predictions``-poisoned canary is convicted by the labeled
+  feedback quality gate (rolling logloss), not by latency or errors;
+- a clean canary auto-PROMOTES, and the post-swap predictions bit-match
+  a manual ``Fleet.promote`` of the same model — with the compile ledger
+  pinned flat across the whole begin→verdict cycle (the controller is
+  host-side bookkeeping, zero new XLA programs);
+- a restart mid-window serves the last-good primary and demotes the
+  unvetted candidate to un-promoted (never half-promoted, never
+  resurrected as primary);
+- shadow scoring never degrades real traffic: with the canary wedged,
+  primary requests keep succeeding fast while shadow work is dropped
+  and counted;
+- an unproven candidate is extended, then rolled back at the hard
+  window bound, and the post-rollback cooldown backs off exponentially
+  and convicts an immediate re-reload with reason ``cooldown``.
+
+Stub forests drive the scheduling chaos (deterministic, fast); the
+promote-bit-match and restart tests run real ``CompiledForest``s.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import compile_ledger, prom, tracing
+from lightgbm_tpu.serve import (Fleet, GuardrailPolicy, FeedbackTracker,
+                                PredictServer, PromotionController, Replica,
+                                ReplicaSet, ShadowScorer)
+from lightgbm_tpu.serve.fleet import ModelManager
+from lightgbm_tpu.serve.forest import CompiledForest
+from lightgbm_tpu.serve.lifecycle import IDLE, OBSERVING
+from lightgbm_tpu.testing import faults
+
+pytestmark = [pytest.mark.serve, pytest.mark.lifecycle]
+
+BUCKETS = [16, 64]
+
+
+@pytest.fixture
+def tracer(tmp_path, monkeypatch):
+    """Arm the process tracer (same pattern as tests/test_fleet.py)."""
+    path = tmp_path / "trace_events.json"
+    tracing.TRACER.reset()
+    monkeypatch.setenv(tracing.ENV_PATH, str(path))
+    tracing.TRACER.configure()
+    yield path
+    tracing.TRACER.disable()
+    tracing.TRACER.reset()
+    tracing.TRACER.path = None
+
+
+class StubForest:
+    """Duck-typed CompiledForest: constant predictions, optional fixed
+    service time (the test_serve_chaos.py stub)."""
+
+    num_trees = 1
+    num_class = 1
+
+    def __init__(self, service_s=0.0, value=1.0, num_features=4,
+                 device=None):
+        self.service_s = float(service_s)
+        self.value = float(value)
+        self.num_features = int(num_features)
+        self.device = device
+
+    def batched_fn(self):
+        def fn(rows):
+            if self.service_s:
+                time.sleep(self.service_s)
+            out = np.full((1, rows.shape[0]), self.value, np.float32)
+            return out, out
+        return fn
+
+    def to_device(self, device):
+        return StubForest(self.service_s, self.value, self.num_features,
+                          device)
+
+    def warmup(self, buckets=None, max_bucket=None):
+        return self
+
+    def info(self):
+        return {"num_trees": 1, "num_class": 1,
+                "num_features": self.num_features}
+
+
+def _canary_fleet(n_primary=2, canary_value=2.0, canary_weight=0.25,
+                  primary_value=1.0, **kw):
+    """A stub fleet WITH a canary slot (generation 2), watchdog off —
+    verdicts must come from the lifecycle controller, not the health
+    state machine."""
+    preps = [Replica(StubForest(value=primary_value), i, "primary", 1,
+                     max_batch=256, max_delay_s=0.0, max_queue=0)
+             for i in range(n_primary)]
+    crep = Replica(StubForest(value=canary_value), 0, "canary", 2,
+                   max_batch=256, max_delay_s=0.0, max_queue=0)
+    fleet = Fleet(ReplicaSet(preps, "primary", 1),
+                  ReplicaSet([crep], "canary", 2,
+                             model_path="stub-canary.txt"),
+                  canary_weight=canary_weight,
+                  watchdog_interval_s=0.0, **kw)
+    return fleet, preps, crep
+
+
+def _prom_counter(name):
+    parsed = prom.parse_text(prom.render())
+    vals = [v for n, labels, v in parsed["samples"]
+            if n == f"lightgbm_tpu_{name}" and not labels]
+    return vals[0] if vals else 0.0
+
+
+def _hammer(fleet, n_threads, stop_evt, errors, served):
+    def client():
+        while not stop_evt.is_set():
+            try:
+                res = fleet.submit(np.ones((1, 4), np.float32),
+                                   timeout=30.0)
+                served.append(float(np.asarray(res.out)[0, 0]))
+            except Exception as exc:   # any client-visible failure
+                errors.append(repr(exc))
+                return
+    threads = [threading.Thread(target=client) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    return threads
+
+
+def _wait_until(pred, timeout_s=8.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval_s)
+    return pred()
+
+
+def _train_and_save(tmp_path, name, rounds, lr=0.1, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(800, 6))
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "min_data_in_leaf": 20, "learning_rate": lr},
+                    lgb.Dataset(X, label=y), num_boost_round=rounds)
+    path = str(tmp_path / name)
+    bst.save_model(path)
+    return path, X
+
+
+# ---------------------------------------------------------------------------
+# the acceptance gate: slow canary under load -> auto-rollback, zero failures
+
+
+def test_slow_canary_rolls_back_under_load_zero_failures(tmp_path, tracer):
+    fleet, _preps, _crep = _canary_fleet()
+    manager = ModelManager(fleet, state_file=str(tmp_path / "state.json"))
+    policy = GuardrailPolicy(min_samples=12, latency_ratio=3.0,
+                             error_rate=1.0)
+    ctrl = PromotionController(fleet, manager, policy, window_s=1.0,
+                               max_window_s=8.0, cooldown_s=60.0,
+                               interval_s=0.05)
+    r0 = _prom_counter("lifecycle_rollbacks_total")
+    lr0 = _prom_counter("lifecycle_rollback_latency_ratio")
+    errors, served = [], []
+    stop_evt = threading.Event()
+    try:
+        with faults.slow_replica(fleet, 0, 0.05, model="canary"):
+            ctrl.begin("stub-canary.txt", 2)
+            assert ctrl.stats()["phase"] == OBSERVING
+            threads = _hammer(fleet, 4, stop_evt, errors, served)
+            assert _wait_until(lambda: not fleet.has_canary(),
+                               timeout_s=10.0), \
+                f"slow canary never rolled back: {ctrl.stats()}"
+            # traffic keeps flowing on the primary after the rollback
+            n_after = len(served)
+            assert _wait_until(lambda: len(served) > n_after + 50,
+                               timeout_s=5.0)
+            stop_evt.set()
+            for t in threads:
+                t.join(timeout=10.0)
+    finally:
+        stop_evt.set()
+        ctrl.close()
+        fleet.close(drain=False)
+
+    assert errors == [], f"client-visible failures during rollback: {errors[:3]}"
+    assert served, "no requests served at all"
+    # exactly one verdict, reason named everywhere it should be
+    assert _prom_counter("lifecycle_rollbacks_total") == r0 + 1
+    assert _prom_counter("lifecycle_rollback_latency_ratio") == lr0 + 1
+    stats = ctrl.stats()
+    assert stats["phase"] == IDLE
+    assert stats["last_verdict"]["outcome"] == "rollback"
+    assert stats["last_verdict"]["reason"] == "latency_ratio"
+    gate = stats["last_verdict"]["verdict"]["gates"]["latency_ratio"]
+    assert gate["armed"] and not gate["ok"]
+    # verdict reaches the event stream
+    verdicts = [e for e in tracing.TRACER.events()
+                if e.get("name") == "Serve::verdict"]
+    assert any((e.get("args") or {}).get("outcome") == "rollback"
+               and (e.get("args") or {}).get("reason") == "latency_ratio"
+               for e in verdicts), verdicts
+    # and the state file carries no half-promoted candidate
+    state = json.loads((tmp_path / "state.json").read_text())
+    assert "canary" not in state
+    assert state["lifecycle"]["phase"] == IDLE
+    assert state["lifecycle"]["consecutive_rollbacks"] == 1
+
+
+# ---------------------------------------------------------------------------
+# quality gate: a skewed canary is convicted by labeled feedback
+
+
+def test_skewed_canary_convicted_by_quality_gate(tmp_path):
+    fleet, _preps, _crep = _canary_fleet(canary_value=0.5,
+                                         canary_weight=0.5,
+                                         primary_value=0.5)
+    manager = ModelManager(fleet, state_file=str(tmp_path / "state.json"))
+    fb = FeedbackTracker()
+    policy = GuardrailPolicy(min_samples=8, latency_ratio=0.0,
+                             error_rate=1.0)
+    ctrl = PromotionController(fleet, manager, policy, window_s=30.0,
+                               cooldown_s=0.0, feedback=fb,
+                               interval_s=30.0)
+    q0 = _prom_counter("lifecycle_rollback_quality")
+    try:
+        # primary answers 0.5 (logloss ln2); the skewed canary answers
+        # ~0.99 — confidently wrong on every label-0 request
+        with faults.skew_predictions(fleet, 0.49, model="canary") as stats:
+            assert stats["offset"] == pytest.approx(0.49)
+            ctrl.begin("stub-canary.txt", 2)
+            rows = np.ones((1, 4), np.float32)
+            # labels alternate PER MODEL (independent of how routing
+            # interleaves the two models): both windows see a 50/50
+            # label mix, so only the scores differ
+            seen = {"primary": 0, "canary": 0}
+            for i in range(64):
+                res = fleet.submit(rows, timeout=30.0)
+                score = float(np.asarray(res.out)[0, 0])
+                fb.note(i, res.model, score)
+                assert fb.feedback(i, float(seen[res.model] % 2))
+                seen[res.model] += 1
+            quality = fb.quality()
+            assert quality["canary"]["n"] >= policy.min_samples
+            assert quality["primary"]["n"] >= policy.min_samples
+            assert quality["canary"]["logloss"] > \
+                quality["primary"]["logloss"] + 0.05
+            ctrl.tick()
+    finally:
+        ctrl.close()
+        fleet.close(drain=False)
+    stats = ctrl.stats()
+    assert stats["last_verdict"]["outcome"] == "rollback"
+    assert stats["last_verdict"]["reason"] == "quality"
+    assert not fleet.has_canary()
+    assert _prom_counter("lifecycle_rollback_quality") == q0 + 1
+    # the rolling-quality gauges that fed the verdict are published
+    assert obs.get_gauge(obs.labeled_name("lifecycle_quality_logloss",
+                                          model="canary")) is not None
+
+
+# ---------------------------------------------------------------------------
+# clean canary -> auto-promote, bit-match vs manual Fleet.promote
+
+
+def test_clean_canary_auto_promotes_bitmatch_manual(tmp_path):
+    path_a, X = _train_and_save(tmp_path, "a.txt", rounds=3)
+    path_b, _ = _train_and_save(tmp_path, "b.txt", rounds=5, lr=0.3)
+    rows5 = X[:5].astype(np.float32)
+
+    def _build():
+        fa = CompiledForest.from_booster(lgb.Booster(model_file=path_a),
+                                         buckets=BUCKETS)
+        fb_ = CompiledForest.from_booster(lgb.Booster(model_file=path_b),
+                                          buckets=BUCKETS)
+        fa.warmup(max_bucket=64)
+        fb_.warmup(max_bucket=64)
+        return fb_, Fleet.build(fa, devices=[None], canary_forest=fb_,
+                                canary_weight=0.5, max_batch=64,
+                                max_delay_s=0.001, warm=False)
+
+    forest_b1, fleet = _build()        # the controller promotes this one
+    forest_b2, fleet_manual = _build()  # the operator promotes this one
+    manager = ModelManager(fleet, state_file=str(tmp_path / "state.json"))
+    policy = GuardrailPolicy(min_samples=5, latency_ratio=0.0,
+                             error_rate=1.0)
+    ctrl = None
+    p0 = _prom_counter("lifecycle_promotions_total")
+    try:
+        # everything compiled and warmed BEFORE the cycle under test
+        fleet.submit(rows5, timeout=30.0)
+        fleet_manual.submit(rows5, timeout=30.0)
+        fleet_manual.promote(forest_b2, target="primary",
+                             model_path=path_b)
+        want = np.asarray(fleet_manual.submit(rows5, timeout=30.0).out)
+
+        n_ledger = len(compile_ledger.events())
+        ctrl = PromotionController(fleet, manager, policy, window_s=0.4,
+                                   max_window_s=4.0, cooldown_s=60.0,
+                                   interval_s=0.05)
+        ctrl.begin(path_b, 2)
+
+        def _feed():
+            if fleet.has_canary():
+                fleet.submit(rows5, timeout=30.0)
+                return False
+            return True
+        assert _wait_until(_feed, timeout_s=20.0), \
+            f"clean canary never promoted: {ctrl.stats()}"
+        res = fleet.submit(rows5, timeout=30.0)
+        # the promoted primary IS the canary forest: bit-match against
+        # the manually promoted fleet, same generation arithmetic
+        assert res.model == "primary"
+        assert np.array_equal(np.asarray(res.out), want)
+        assert fleet.generation == fleet_manual.generation == 3
+        # zero new XLA programs across begin -> verdict -> post-swap serve
+        assert len(compile_ledger.events()) == n_ledger
+    finally:
+        if ctrl is not None:
+            ctrl.close()
+        fleet.close(drain=False)
+        fleet_manual.close(drain=False)
+
+    stats = ctrl.stats()
+    assert stats["last_verdict"]["outcome"] == "promote"
+    assert stats["last_verdict"]["candidate"] == path_b
+    assert _prom_counter("lifecycle_promotions_total") == p0 + 1
+    state = json.loads((tmp_path / "state.json").read_text())
+    assert state["primary"]["model"] == path_b
+    assert "canary" not in state
+    assert state["lifecycle"]["phase"] == IDLE
+
+
+# ---------------------------------------------------------------------------
+# crash safety: restart mid-window -> last-good primary, candidate demoted
+
+
+def test_restart_mid_window_serves_last_good_primary(tmp_path):
+    """SIGKILL-shaped restart between ``/reload target=canary`` and the
+    verdict: the relaunched server serves the last-good primary, the
+    unvetted candidate is NOT resurrected (neither as canary nor as a
+    half-promoted primary), and the interruption is named."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.serve.server import serve_from_config
+
+    path_a, X = _train_and_save(tmp_path, "a.txt", rounds=3)
+    path_b, _ = _train_and_save(tmp_path, "b.txt", rounds=5, lr=0.3)
+    state = tmp_path / "serve_state.json"
+    conf = {"task": "serve", "input_model": path_a, "serve_port": 0,
+            "serve_state_file": str(state), "serve_max_batch": 64,
+            "predict_buckets": [16, 64], "serve_watchdog_ms": 0,
+            "serve_canary_weight": 0.2, "lifecycle_window_s": 60.0,
+            "verbose": -1}
+    srv = serve_from_config(Config(dict(conf))).start()
+    try:
+        assert srv._ready.wait(120.0)
+        assert srv.controller is not None
+        host, port = srv.address
+        req = urllib.request.Request(
+            f"http://{host}:{port}/reload",
+            data=json.dumps({"model": path_b,
+                             "target": "canary"}).encode())
+        resp = json.loads(urllib.request.urlopen(req, timeout=180).read())
+        assert resp["target"] == "canary"
+        assert srv.controller.stats()["phase"] == OBSERVING
+        persisted = json.loads(state.read_text())
+        assert persisted["lifecycle"]["phase"] == OBSERVING
+        assert persisted["lifecycle"]["candidate"] == path_b
+    finally:
+        # stop() without a verdict: the state file still says a window
+        # was open — exactly what a SIGKILL mid-evaluation leaves behind
+        srv.stop()
+
+    i0 = _prom_counter("lifecycle_interrupted_total")
+    srv2 = serve_from_config(Config(dict(conf))).start()
+    try:
+        assert srv2._ready.wait(120.0)
+        # last-good primary (model A), candidate demoted to un-promoted
+        a_trees = lgb.Booster(model_file=path_a).num_trees()
+        assert srv2.forest.num_trees == a_trees
+        assert not srv2.fleet.has_canary()
+        assert _prom_counter("lifecycle_interrupted_total") == i0 + 1
+        verdict = srv2.controller.stats()["last_verdict"]
+        assert verdict["outcome"] == "interrupted"
+        assert verdict["reason"] == "restart_mid_window"
+        assert verdict["candidate"] == path_b
+        # the re-persisted record no longer claims an open window
+        assert json.loads(state.read_text())["lifecycle"]["phase"] == IDLE
+        # served predictions come from model A, not the candidate
+        host, port = srv2.address
+        body = json.dumps({"rows": X[:3].tolist()}).encode()
+        req = urllib.request.Request(
+            f"http://{host}:{port}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        want = CompiledForest.from_booster(
+            lgb.Booster(model_file=path_a), buckets=[16, 64]).predict(
+                X[:3].astype(np.float32), device_binning=True)
+        np.testing.assert_allclose(
+            np.asarray(resp["predictions"], np.float32),
+            np.asarray(want, np.float32), rtol=1e-6, atol=1e-6)
+    finally:
+        srv2.stop()
+
+
+# ---------------------------------------------------------------------------
+# shadow isolation: a wedged canary cannot slow or shed real traffic
+
+
+def test_shadow_never_degrades_primary_traffic():
+    fleet, _preps, _crep = _canary_fleet(canary_weight=0.0)
+    scorer = ShadowScorer(fleet, fraction=1.0, queue_max=4, timeout_s=0.2)
+    d0 = _prom_counter("lifecycle_shadow_dropped_total")
+    errors, latencies = [], []
+    stop_evt = threading.Event()
+
+    def client():
+        rows = np.ones((1, 4), np.float32)
+        while not stop_evt.is_set():
+            t0 = time.monotonic()
+            try:
+                fleet.submit(rows, timeout=5.0)
+            except Exception as exc:
+                errors.append(repr(exc))
+                return
+            latencies.append(time.monotonic() - t0)
+            scorer.offer(rows)
+
+    try:
+        with faults.wedge_replica(fleet, 0, model="canary"):
+            threads = [threading.Thread(target=client) for _ in range(3)]
+            for t in threads:
+                t.start()
+            assert _wait_until(
+                lambda: _prom_counter("lifecycle_shadow_dropped_total")
+                > d0, timeout_s=8.0), "shadow queue never dropped"
+            time.sleep(0.3)
+            stop_evt.set()
+            for t in threads:
+                t.join(timeout=10.0)
+    finally:
+        stop_evt.set()
+        scorer.close()
+        fleet.close(drain=False)
+    assert errors == [], f"shadow load failed real requests: {errors[:3]}"
+    assert len(latencies) > 100
+    p99 = float(np.percentile(np.asarray(latencies), 99))
+    assert p99 < 0.5, f"primary p99 degraded to {p99:.3f}s under shadow"
+    assert _prom_counter("lifecycle_shadow_dropped_total") > d0
+
+
+def test_shadow_fraction_sampling_and_bounds():
+    fleet, _preps, _crep = _canary_fleet(canary_weight=0.0)
+    try:
+        with pytest.raises(ValueError, match="serve_shadow"):
+            ShadowScorer(fleet, fraction=1.5)
+        scorer = ShadowScorer(fleet, fraction=0.25, queue_max=64)
+        try:
+            rows = np.ones((1, 4), np.float32)
+            picks = [scorer.offer(rows) for _ in range(20)]
+            # deterministic accumulator: exactly every 4th offer mirrors
+            assert sum(picks) == 5
+            assert picks[3] and picks[7]
+        finally:
+            scorer.close()
+    finally:
+        fleet.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# extend -> bounded -> insufficient_samples rollback -> cooldown backoff
+
+
+def test_unproven_candidate_extends_then_cooldown_backoff(tmp_path):
+    fleet, _preps, _crep = _canary_fleet()
+    manager = ModelManager(fleet, state_file=str(tmp_path / "state.json"))
+    policy = GuardrailPolicy(min_samples=10**6, latency_ratio=0.0,
+                             error_rate=1.0)
+    ctrl = PromotionController(fleet, manager, policy, window_s=0.08,
+                               max_window_s=0.2, cooldown_s=60.0,
+                               interval_s=30.0)
+    e0 = _prom_counter("lifecycle_extensions_total")
+    r0 = _prom_counter("lifecycle_rollbacks_total")
+    c0 = _prom_counter("lifecycle_rollback_cooldown")
+    i0 = _prom_counter("lifecycle_rollback_insufficient_samples")
+    try:
+        ctrl.begin("stub-canary.txt", 2)
+        ctrl.tick()                      # inside the window: no action
+        assert ctrl.stats()["phase"] == OBSERVING
+        time.sleep(0.1)
+        ctrl.tick()                      # past window, under hard end
+        assert _prom_counter("lifecycle_extensions_total") == e0 + 1
+        assert ctrl.stats()["phase"] == OBSERVING
+        time.sleep(0.2)
+        ctrl.tick()                      # past the hard bound: verdict
+        stats = ctrl.stats()
+        assert stats["phase"] == IDLE
+        assert stats["last_verdict"]["reason"] == "insufficient_samples"
+        assert not fleet.has_canary()
+        assert _prom_counter("lifecycle_rollbacks_total") == r0 + 1
+        assert _prom_counter(
+            "lifecycle_rollback_insufficient_samples") == i0 + 1
+        # an immediate re-reload hits the sticky cooldown, and the
+        # backoff doubles: 60s -> 120s
+        ctrl.begin("stub-canary.txt", 3)
+        stats = ctrl.stats()
+        assert stats["last_verdict"]["reason"] == "cooldown"
+        assert stats["consecutive_rollbacks"] == 2
+        assert stats["last_verdict"]["cooldown_s"] == pytest.approx(120.0)
+        assert stats["cooldown_remaining_s"] > 60.0
+        assert _prom_counter("lifecycle_rollback_cooldown") == c0 + 1
+        # persisted for the next boot: a crash cannot launder the history
+        persisted = json.loads((tmp_path / "state.json").read_text())
+        assert persisted["lifecycle"]["consecutive_rollbacks"] == 2
+        assert persisted["lifecycle"]["cooldown_until_t"] is not None
+    finally:
+        ctrl.close()
+        fleet.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: POST /feedback joins labels, /stats carries the block
+
+
+def test_feedback_endpoint_and_stats_block():
+    preps = [Replica(StubForest(value=0.8), i, "primary", 1,
+                     max_batch=256, max_delay_s=0.0, max_queue=0)
+             for i in range(1)]
+    fleet = Fleet(ReplicaSet(preps, "primary", 1))
+    srv = PredictServer(fleet, port=0).start()
+    host, port = srv.address
+    base = f"http://{host}:{port}"
+
+    def _post(path, payload, timeout=30):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        return json.loads(urllib.request.urlopen(req, timeout=timeout)
+                          .read())
+
+    try:
+        resp = _post("/predict", {"rows": [[1.0, 1.0, 1.0, 1.0]]})
+        req_id = resp["request_id"]
+        assert resp["model"] == "primary"
+        ack = _post("/feedback", {"request_id": req_id, "label": 1})
+        assert ack["status"] == "ok" and ack["request_id"] == req_id
+        # a second delivery for the same id is a 404 (already joined)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post("/feedback", {"request_id": req_id, "label": 1})
+        assert err.value.code == 404
+        err.value.read()
+        # malformed label -> 400
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post("/feedback", {"request_id": 1, "label": "nan"})
+        assert err.value.code == 400
+        err.value.read()
+        # the stats block carries the rolling quality the label fed
+        stats = json.loads(urllib.request.urlopen(
+            base + "/stats", timeout=30).read())
+        assert "lifecycle" in stats
+        quality = stats["lifecycle"]["quality"]
+        assert quality["primary"]["n"] == 1
+        assert stats["lifecycle"]["controller"] is None  # not configured
+        assert obs.get_gauge(obs.labeled_name(
+            "lifecycle_quality_logloss", model="primary")) is not None
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: EFB multi-host refusal is now a visible gauge
+
+
+def test_efb_disabled_multihost_gauge(monkeypatch):
+    from lightgbm_tpu.io.bundling import plan_bundles
+    from lightgbm_tpu.parallel import multihost
+
+    monkeypatch.setattr(multihost, "process_rank_world", lambda: (0, 2))
+    obs.set_gauge("efb_disabled_multihost", 0)
+    sample = np.zeros((4, 1))
+    plan = plan_bundles(sample, [object()], [0],
+                        max_conflict_rate=0.0, max_total_bin=255)
+    assert plan is None
+    assert obs.get_gauge("efb_disabled_multihost") == 1

@@ -342,7 +342,8 @@ def test_serve_metrics_and_full_stats():
         # histogram summaries — plus the fleet topology (round 8) — so
         # new metric names can never drift out
         stats = json.loads(_get(base + "/stats")[0])
-        assert set(stats) == {"counters", "gauges", "histograms", "fleet"}
+        assert set(stats) == {"counters", "gauges", "histograms", "fleet",
+                              "lifecycle"}
         assert stats["fleet"]["generation"] >= 1
         assert stats["fleet"]["replicas"], "fleet topology missing"
         assert stats["counters"]["serve_requests"] >= 3

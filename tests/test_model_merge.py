@@ -1,0 +1,209 @@
+"""Safe forest merging + warm-start delta training (the retrain half of
+the guarded lifecycle).
+
+- ``Booster.merge(other, shrinkage_decay=d)`` predicts exactly
+  ``base + d * delta`` (raw scores, bit-equal: d is a power of two and
+  the scaled copies carry exact leaf values), and the merged model
+  round-trips through the model TEXT unchanged;
+- incompatible merges refuse with NAMED errors — num_class, feature
+  width, objective, a shrinkage_decay outside (0, 1] — from Python AND
+  through ``LGBM_BoosterMerge`` (C API return -1 + LGBM_GetLastError);
+- ``engine.train_delta(base, fresh_data, num_trees=)`` continues from
+  the base model: its first ``base.num_trees()`` trees bit-match the
+  base's model text.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import LightGBMError
+
+pytestmark = [pytest.mark.lifecycle]
+
+
+def _make_data(seed=0, n=600, width=6):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, width))
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def _train(X, y, rounds, lr=0.1, objective="binary", **params):
+    p = {"objective": objective, "num_leaves": 7, "verbose": -1,
+         "min_data_in_leaf": 20, "learning_rate": lr}
+    p.update(params)
+    return lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+
+def _trees(model_text):
+    """Split a model text into its Tree= blocks (footer stripped, block
+    numbering and trailing whitespace normalized so position-independent
+    content compares byte for byte)."""
+    body = model_text.split("feature importances:", 1)[0]
+    blocks = [b for b in re.split(r"(?=Tree=\d+\n)", body)
+              if b.startswith("Tree=")]
+    return [re.sub(r"^Tree=\d+\n", "", b).rstrip("\n") for b in blocks]
+
+
+# ---------------------------------------------------------------------------
+# exact merge arithmetic + model-text round trip
+
+
+def test_merge_predicts_base_plus_decayed_delta(tmp_path):
+    X, y = _make_data()
+    base = _train(X, y, rounds=4)
+    other = _train(X, y, rounds=3, lr=0.3)
+    pb = base.predict(X, raw_score=True)
+    po = other.predict(X, raw_score=True)
+
+    path_b = str(tmp_path / "base.txt")
+    path_o = str(tmp_path / "other.txt")
+    base.save_model(path_b)
+    other.save_model(path_o)
+
+    merged = lgb.Booster(model_file=path_b)
+    out = merged.merge(lgb.Booster(model_file=path_o), shrinkage_decay=0.5)
+    assert out is merged
+    assert merged.num_trees() == base.num_trees() + other.num_trees()
+    pm = merged.predict(X, raw_score=True)
+    assert np.array_equal(pm, pb + 0.5 * po), \
+        f"max dev {np.max(np.abs(pm - (pb + 0.5 * po)))}"
+
+    # full decay keeps the other model verbatim
+    merged1 = lgb.Booster(model_file=path_b)
+    merged1.merge(lgb.Booster(model_file=path_o), shrinkage_decay=1.0)
+    assert np.array_equal(merged1.predict(X, raw_score=True), pb + po)
+
+    # round trip through the model text: same trees, same predictions
+    path_m = str(tmp_path / "merged.txt")
+    merged.save_model(path_m)
+    reloaded = lgb.Booster(model_file=path_m)
+    assert reloaded.num_trees() == merged.num_trees()
+    assert np.array_equal(reloaded.predict(X, raw_score=True), pm)
+
+
+def test_merge_uses_config_shrinkage_decay_by_default(tmp_path):
+    X, y = _make_data()
+    base = _train(X, y, rounds=3)
+    other = _train(X, y, rounds=2, lr=0.3)
+    path_b = str(tmp_path / "base.txt")
+    path_o = str(tmp_path / "other.txt")
+    base.save_model(path_b)
+    other.save_model(path_o)
+    pb = base.predict(X, raw_score=True)
+    po = other.predict(X, raw_score=True)
+
+    merged = lgb.Booster(model_file=path_b, params={"shrinkage_decay": 0.25})
+    merged.merge(lgb.Booster(model_file=path_o))
+    assert np.array_equal(merged.predict(X, raw_score=True),
+                          pb + 0.25 * po)
+
+
+# ---------------------------------------------------------------------------
+# named refusals (Python surface)
+
+
+def test_merge_refusals_are_named():
+    X, y = _make_data()
+    base = _train(X, y, rounds=2)
+
+    # feature width mismatch
+    Xw, yw = _make_data(seed=1, width=9)
+    wide = _train(Xw, yw, rounds=2)
+    with pytest.raises(LightGBMError, match="feature width mismatch"):
+        base.merge(wide)
+
+    # objective mismatch (same width)
+    reg = _train(X, y, rounds=2, objective="regression")
+    with pytest.raises(LightGBMError, match="objective mismatch"):
+        base.merge(reg)
+
+    # num_class mismatch (multiclass vs binary, same width)
+    ym = (np.arange(len(y)) % 3).astype(np.float64)
+    multi = _train(X, ym, rounds=2, objective="multiclass", num_class=3)
+    with pytest.raises(LightGBMError, match="num_class mismatch"):
+        multi.merge(base)
+
+    # shrinkage_decay outside (0, 1]
+    other = _train(X, y, rounds=2, lr=0.3)
+    for bad in (0.0, -0.5, 1.5):
+        with pytest.raises(LightGBMError, match="shrinkage_decay"):
+            base.merge(other, shrinkage_decay=bad)
+
+
+# ---------------------------------------------------------------------------
+# named refusals through the C API (LGBM_BoosterMerge, satellite)
+
+
+def test_c_api_booster_merge_routes_validated_merge(tmp_path):
+    cffi = pytest.importorskip("cffi")
+    from lightgbm_tpu.capi import impl
+
+    X, y = _make_data()
+    base = _train(X, y, rounds=3)
+    other = _train(X, y, rounds=2, lr=0.3)
+    Xw, yw = _make_data(seed=1, width=9)
+    wide = _train(Xw, yw, rounds=2)
+    paths = {}
+    for name, bst in (("base", base), ("other", other), ("wide", wide)):
+        paths[name] = str(tmp_path / f"{name}.txt")
+        bst.save_model(paths[name])
+
+    f = cffi.FFI()
+    impl.bind(f, register_externs=False)
+
+    def _load(path):
+        out_iter = f.new("int *")
+        out = f.new("void **")
+        assert impl.LGBM_BoosterCreateFromModelfile(
+            f.new("char[]", path.encode()), out_iter, out) == 0
+        return out[0]
+
+    h_base = _load(paths["base"])
+    h_other = _load(paths["other"])
+    h_wide = _load(paths["wide"])
+    try:
+        # incompatible: -1 + the named error through LGBM_GetLastError
+        assert impl.LGBM_BoosterMerge(h_base, h_wide) == -1
+        err = f.string(impl.LGBM_GetLastError()).decode()
+        assert "feature width mismatch" in err
+
+        # compatible: 0, trees appended (reference MergeFrom semantics)
+        n_before = base.num_trees()
+        assert impl.LGBM_BoosterMerge(h_base, h_other) == 0
+        out_n = f.new("int *")
+        assert impl.LGBM_BoosterGetCurrentIteration(h_base, out_n) == 0
+        assert out_n[0] == n_before + other.num_trees()
+    finally:
+        for h in (h_base, h_other, h_wide):
+            impl.LGBM_BoosterFree(h)
+
+
+# ---------------------------------------------------------------------------
+# warm-start delta training: base trees preserved bit-for-bit
+
+
+def test_train_delta_preserves_base_trees(tmp_path):
+    X, y = _make_data()
+    base = _train(X, y, rounds=4)
+    path_b = str(tmp_path / "base.txt")
+    base.save_model(path_b)
+
+    X2, y2 = _make_data(seed=7)
+    delta = lgb.train_delta(path_b, lgb.Dataset(X2, label=y2), num_trees=3,
+                            params={"objective": "binary", "num_leaves": 7,
+                                    "verbose": -1, "min_data_in_leaf": 20})
+    assert delta.num_trees() == base.num_trees() + 3
+
+    path_d = str(tmp_path / "delta.txt")
+    delta.save_model(path_d)
+    base_trees = _trees(open(path_b).read())
+    delta_trees = _trees(open(path_d).read())
+    assert len(base_trees) == base.num_trees()
+    assert len(delta_trees) == delta.num_trees()
+    # the continuation never rewrites history: the first num_trees()
+    # blocks of the delta model ARE the base model's, byte for byte
+    assert delta_trees[:len(base_trees)] == base_trees
