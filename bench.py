@@ -79,6 +79,116 @@ def make_ctr_like(num_data: int, num_features: int = 2000,
     return X, y
 
 
+def make_piecewise_linear(num_data: int, num_features: int = 10,
+                          seed: int = 5):
+    """Piece-wise linear regression synthetic (docs/LINEAR_TREES.md):
+    axis-aligned regions whose responses are AFFINE in a few features —
+    the workload linear trees are built for.  Constant-leaf trees must
+    staircase each slope; an affine leaf captures it in one fit."""
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-3.0, 3.0, size=(num_data, num_features))
+    y = np.where(X[:, 0] > 0.0,
+                 2.0 * X[:, 1] - 0.7 * X[:, 2] + 1.0,
+                 np.where(X[:, 1] > 0.5,
+                          -1.5 * X[:, 2] + 0.4 * X[:, 3],
+                          0.8 * X[:, 3] + 0.3))
+    y = y + 0.05 * rng.normal(size=num_data)
+    return X, y.astype(np.float64)
+
+
+def bench_linear() -> None:
+    """--dataset linear: piece-wise linear trees A/B benchmark.
+
+    Trains a constant-leaf and a linear-leaf booster on the same
+    piece-wise linear synthetic and reports trees-to-target (rounds the
+    linear run needs to reach the constant run's best l2), per-round
+    fit seconds, and the leaf-fit fallback rate.  One BENCH-style JSON
+    line; ``linear`` block passed through by tools/bench_regress.py."""
+    num_data = int(os.environ.get("BENCH_LINEAR_ROWS", 100_000))
+    num_iters = int(os.environ.get("BENCH_LINEAR_ITERS", 60))
+    max_feats = int(os.environ.get("BENCH_LINEAR_K", 4))
+
+    import jax
+    from lightgbm_tpu.utils import compile_cache
+    compile_cache.setup()
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu import obs as _obs
+    _obs.devprof.configure(None)
+
+    X, y = make_piecewise_linear(num_data)
+    params = {"objective": "regression", "metric": "l2",
+              "num_leaves": 31, "max_bin": 255, "learning_rate": 0.1,
+              "min_data_in_leaf": 50, "num_iterations": num_iters}
+    ds = BinnedDataset.from_matrix(X, y, max_bin=255, min_data_in_leaf=50,
+                                   keep_raw=True)
+
+    def run(linear: bool):
+        p = dict(params)
+        if linear:
+            p.update({"linear_tree": True, "linear_lambda": 0.01,
+                      "linear_max_leaf_features": max_feats})
+        booster = GBDT(Config(p), ds)
+        per_round = []
+        curve = []
+        for _ in range(num_iters):
+            t0 = time.time()
+            booster.train_one_iter()
+            jax.block_until_ready(booster.train_data.score)
+            per_round.append(time.time() - t0)
+            curve.append(float(booster.eval_metrics()
+                               .get("training", {}).get("l2", np.inf)))
+        return booster, per_round, curve
+
+    fb_before = _obs.get_counter("linear_fallback_total")
+    t0 = time.time()
+    _, const_rounds, const_curve = run(linear=False)
+    _, lin_rounds, lin_curve = run(linear=True)
+    total_s = time.time() - t0
+    fb_total = _obs.get_counter("linear_fallback_total") - fb_before
+
+    target = min(const_curve)                    # constant run's best l2
+    trees_to_target = next(
+        (i + 1 for i, v in enumerate(lin_curve) if v <= target), None)
+    num_leaves = int(params["num_leaves"])
+    fit_rate = fb_total / float(num_iters * num_leaves)
+
+    bench_json = {
+        "metric": f"linear_tree_ab_piecewise{num_data // 1000}k_"
+                  f"31leaves_l2",
+        "value": (round(trees_to_target / float(num_iters), 4)
+                  if trees_to_target else None),
+        "unit": "tree_ratio_to_const_best",
+        "linear": {
+            "rows": num_data,
+            "iterations": num_iters,
+            "max_leaf_features": max_feats,
+            "const_best_l2": round(target, 6),
+            "linear_best_l2": round(min(lin_curve), 6),
+            "trees_to_const_best": trees_to_target,
+            "const_round_s_median": round(
+                statistics.median(const_rounds), 4),
+            "linear_round_s_median": round(
+                statistics.median(lin_rounds), 4),
+            "fit_s_per_round_median": round(
+                statistics.median(lin_rounds)
+                - statistics.median(const_rounds), 4),
+            "fallback_total": int(fb_total),
+            "fallback_rate": round(fit_rate, 4),
+        },
+        "compile_events": None,
+    }
+    from lightgbm_tpu.obs import compile_ledger
+    bench_json["compile_events"] = compile_ledger.summary(5)
+    bench_json["profile"], bench_json["device"] = _profile_blocks()
+    print(json.dumps(bench_json))
+    print(f"# device={jax.devices()[0].platform} total_s={total_s:.1f} "
+          f"const_best={target:.6f} linear_best={min(lin_curve):.6f} "
+          f"trees_to_target={trees_to_target} fallback={fb_total}",
+          file=sys.stderr)
+
+
 def _profile_blocks():
     """The BENCH ``profile`` + ``device`` blocks (obs/devprof.py,
     obs/devcaps.py).  Always emitted: ``profile.mode`` records whether
@@ -531,6 +641,9 @@ if __name__ == "__main__":
             sys.argv[1:], "concurrency",
             os.environ.get("BENCH_PREDICT_CONCURRENCY", "0"))))
     else:
-        main(dataset=_parse_opt(sys.argv[1:], "dataset",
-                                os.environ.get("BENCH_DATASET",
-                                               "higgslike")))
+        _ds = _parse_opt(sys.argv[1:], "dataset",
+                         os.environ.get("BENCH_DATASET", "higgslike"))
+        if _ds == "linear":
+            bench_linear()
+        else:
+            main(dataset=_ds)

@@ -153,6 +153,17 @@ DESC = {
     "lambda_l1": "L1 regularization",
     "lambda_l2": "L2 regularization",
     "min_gain_to_split": "minimum gain to accept a split",
+    "linear_tree": "fit an affine model in each leaf over its split-path "
+                   "features (batched on-device ridge solve after growth; "
+                   "needs raw feature values — docs/LINEAR_TREES.md)",
+    "linear_lambda": "ridge strength on the affine leaves' slope terms "
+                     "(linear_tree; lambda_l2 regularizes the intercept)",
+    "linear_max_leaf_features": "K: path features per affine leaf, a "
+                                "static pad width so every leaf fit "
+                                "shares one compiled program (0 "
+                                "degenerates linear_tree to constant "
+                                "leaves, bit-identical to linear_tree="
+                                "false; docs/LINEAR_TREES.md)",
     "max_depth": "depth limit (-1 = none)",
     "early_stopping_round": "stop when no metric improves in this many "
                             "rounds",

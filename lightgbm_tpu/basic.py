@@ -245,7 +245,8 @@ class Dataset:
                 data_random_seed=cfg.data_random_seed,
                 enable_bundle=bool(cfg.enable_bundle),
                 max_conflict_rate=float(cfg.max_conflict_rate),
-                is_enable_sparse=bool(cfg.is_enable_sparse))
+                is_enable_sparse=bool(cfg.is_enable_sparse),
+                keep_raw=bool(cfg.linear_tree))
         md = self._binned.metadata
         if self.label is not None and self.used_indices is None:
             md.set_label(np.asarray(self.label))
@@ -473,6 +474,12 @@ class Booster:
             if not isinstance(train_set, Dataset):
                 raise TypeError("Training data should be Dataset instance, "
                                 f"met {type(train_set).__name__}")
+            if params.get("linear_tree") and train_set._binned is None:
+                # raw-feature retention is decided at bin time, so the
+                # Dataset must see the flag BEFORE construct() (engine
+                # .train pushes the full params dict the same way)
+                train_set._update_params(
+                    {"linear_tree": params["linear_tree"]})
             train_set.construct()
             self.config = Config({**train_set.params, **params})
             self._booster = create_boosting(self.config, train_set._binned)

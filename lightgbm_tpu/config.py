@@ -174,6 +174,12 @@ _DEFAULTS: Dict[str, Any] = {
     "lambda_l2": 0.0,
     "min_gain_to_split": 0.0,
     "num_leaves": 127,
+    # piece-wise linear trees (models/linear.py, docs/LINEAR_TREES.md):
+    # affine leaf models fitted by a batched ridge solve after growth
+    "linear_tree": False,
+    "linear_lambda": 0.0,            # ridge strength on the slope terms
+    "linear_max_leaf_features": 5,   # K: path features per leaf (static
+                                     # pad width; 0 = constant leaves)
     "feature_fraction_seed": 2,
     "feature_fraction": 1.0,
     "histogram_pool_size": -1.0,
@@ -468,6 +474,13 @@ class Config:
             raise ValueError("feature_screen_warmup must be >= 0")
         if not (0.0 < v["feature_screen_decay"] <= 1.0):
             raise ValueError("feature_screen_decay must be in (0, 1]")
+        if v["linear_lambda"] < 0.0:
+            raise ValueError("linear_lambda must be >= 0 (ridge strength "
+                             "on the per-leaf affine slope terms)")
+        if v["linear_max_leaf_features"] < 0:
+            raise ValueError("linear_max_leaf_features must be >= 0 "
+                             "(0 degenerates linear_tree to constant "
+                             "leaves)")
         if v["bad_data_policy"] not in ("fail_fast", "quarantine"):
             raise ValueError(
                 f"Unknown bad_data_policy {v['bad_data_policy']} "

@@ -182,6 +182,13 @@ class BinnedDataset:
         self.metadata = Metadata()
         self.max_bin = 255
         self.label_idx = 0
+        # [num_used_features, N] f32 raw values (NaN preserved) in USED
+        # feature order — retained only when keep_raw was requested at
+        # bin time (linear_tree needs the raw values for the per-leaf
+        # affine fits; docs/LINEAR_TREES.md).  Streamed two-round loads
+        # never materialize the full matrix, so they leave this None and
+        # linear training refuses with a named error.
+        self.raw: Optional[np.ndarray] = None
 
     # -- construction ---------------------------------------------------
     @classmethod
@@ -198,6 +205,7 @@ class BinnedDataset:
                     enable_bundle: bool = False,
                     max_conflict_rate: float = 0.0,
                     is_enable_sparse: bool = True,
+                    keep_raw: bool = False,
                     ) -> "BinnedDataset":
         """Bin a raw [N, F] float matrix (dataset_loader.cpp:656-820 flow:
         sample rows -> per-feature FindBin -> extract features)."""
@@ -267,6 +275,12 @@ class BinnedDataset:
             for inner in range(len(used)):
                 self.bins[inner] = feature_bins(inner).astype(dtype)
 
+        if keep_raw and used:
+            # feature-major like ``bins`` so the linear-fit gather reads
+            # contiguous lanes; f32 (the fit solves in f32 anyway)
+            self.raw = np.ascontiguousarray(data[:, used].T,
+                                            dtype=np.float32)
+
         self.metadata = Metadata(num_data)
         if label is not None:
             self.metadata.set_label(label)
@@ -301,6 +315,11 @@ class BinnedDataset:
             for inner in range(len(self.used_feature_map)):
                 valid.bins[inner] = feature_bins(inner).astype(
                     self.bins.dtype)
+        if self.raw is not None and self.used_feature_map:
+            # valid raw rides along whenever the training set kept raw:
+            # linear-tree valid scoring replays affine leaves on it
+            valid.raw = np.ascontiguousarray(
+                data[:, self.used_feature_map].T, dtype=np.float32)
         valid.metadata = Metadata(num_data)
         if label is not None:
             valid.metadata.set_label(label)
@@ -320,6 +339,8 @@ class BinnedDataset:
         sub.mappers = self.mappers
         sub.bundle_plan = self.bundle_plan
         sub.bins = np.ascontiguousarray(self.bins[:, indices])
+        if self.raw is not None:
+            sub.raw = np.ascontiguousarray(self.raw[:, indices])
         sub.metadata = Metadata(len(indices))
         md, smd = self.metadata, sub.metadata
         if md.label is not None:
@@ -396,6 +417,10 @@ class BinnedDataset:
             "real_to_inner": self.real_to_inner,
             "meta_json": np.frombuffer(meta_json.encode(), dtype=np.uint8),
         }
+        if self.raw is not None:
+            # keep the cache linear_tree-capable; old caches load with
+            # raw=None and linear training refuses with a named error
+            arrays["raw"] = self.raw
         for key in ("label", "weights", "query_boundaries", "init_score"):
             value = getattr(self.metadata, key)
             if value is not None:
@@ -437,6 +462,7 @@ class BinnedDataset:
         self.feature_names = list(meta["feature_names"])
         self.max_bin = int(meta["max_bin"])
         self.bundle_plan = BundlePlan.from_state(meta.get("bundle_plan"))
+        self.raw = arrays.get("raw")
         self.metadata = Metadata(self.bins.shape[1])
         if "label" in arrays:
             self.metadata.label = arrays["label"]

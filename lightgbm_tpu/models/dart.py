@@ -151,8 +151,7 @@ class DART(GBDT):
 
 
 def _scale_tree(tree: Tree, factor: float) -> Tree:
-    import copy
-    out = copy.deepcopy(tree)
-    out.leaf_value = out.leaf_value * factor
-    out.shrinkage *= factor
-    return out
+    """DART normalization scaling, routed through the single leaf-output
+    mutation point (Tree.scale_leaf_outputs) so affine leaves scale
+    their slopes with their intercepts (docs/LINEAR_TREES.md)."""
+    return tree.scaled_copy(factor)

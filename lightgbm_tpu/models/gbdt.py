@@ -30,9 +30,13 @@ from ..objective import ObjectiveFunction, create_objective
 from ..ops.grow import (GrowParams, SerialComm, grow_tree, pack_tree_arrays,
                         unpack_tree_arrays)
 from ..ops.ordered_grow import grow_tree_ordered, pack_u8_words
-from ..ops.predict import predict_binned_forest, predict_binned_tree
+from ..ops.predict import (predict_binned_forest,
+                           predict_binned_forest_linear,
+                           predict_binned_tree)
 from ..utils import compile_cache, log, timetag
 from ..utils.log import LightGBMError
+from .linear import (LinearParams, affine_epilogue, attach_linear,
+                     fit_leaf_models, pack_linear, unpack_linear)
 from .screening import GainScreener
 from .tree import Tree
 
@@ -54,7 +58,8 @@ def estimate_train_memory(num_data: int, num_features: int, num_leaves: int,
                           bin_itemsize: int = 1, *,
                           donate_score: bool = False,
                           fused_scratch: bool = False,
-                          leaf_cache: bool = True) -> Dict[str, int]:
+                          leaf_cache: bool = True,
+                          linear_k: int = 0) -> Dict[str, int]:
     """Rough per-device HBM footprint (bytes) of training, by component.
 
     The dense-on-device design (SURVEY §7.2) has no sparse-bin fallback
@@ -100,6 +105,16 @@ def estimate_train_memory(num_data: int, num_features: int, num_leaves: int,
     # tiles are scratch resident during the pass (never landed in HBM,
     # but the budget must still cover them — VMEM pressure spills)
     vmem = (2 * f * max_bin * 3 * 4) if fused_scratch else 0
+    # linear_tree (docs/LINEAR_TREES.md): the resident [F, N] f32 raw
+    # copy, the per-row [N, K+1] covariate/phi gather (x2: phi and the
+    # per-slot segment-sum operand are live together), and the batched
+    # normal equations [L, M, M] (A, its Cholesky factor, and the
+    # right-hand sides — ~3 copies at the solve peak)
+    linear = 0
+    if linear_k > 0:
+        m = linear_k + 1
+        linear = (n * f * 4 + 2 * n * m * 4
+                  + 3 * num_leaves * m * m * 4)
     payload = bins_words + digits
     return {
         "bins_device": bins_cm + bins_rm,
@@ -108,9 +123,10 @@ def estimate_train_memory(num_data: int, num_features: int, num_leaves: int,
         "score_double_buffer": double_buf,
         "histogram_cache": cache,
         "vmem_scratch": vmem,
+        "linear_fit": linear,
         "working": payload,
         "total": (bins_cm + bins_rm + 2 * payload + scores + double_buf
-                  + cache + vmem),
+                  + cache + vmem + linear),
     }
 
 
@@ -175,7 +191,8 @@ class _DeviceData:
 
     def __init__(self, dataset: BinnedDataset, num_models: int,
                  with_row_major: bool = False,
-                 padded_rows: Optional[int] = None):
+                 padded_rows: Optional[int] = None,
+                 with_raw: bool = False):
         self.dataset = dataset
         self.num_data = dataset.num_data
         self.padded_rows = max(int(padded_rows or 0), dataset.num_data)
@@ -202,6 +219,20 @@ class _DeviceData:
             from ..ops.ordered_grow import _size_classes
             self.bins_words = _pack_words_padded(
                 self.bins_rm, _size_classes(self.padded_rows)[-1])
+        # raw f32 feature values for the linear-tree fit and its replay
+        # epilogues (docs/LINEAR_TREES.md): NaN imputed to 0.0 ON UPLOAD
+        # so the device fit and every predict path agree exactly; pad
+        # rows read as zero and their zero row_weight keeps them out of
+        # the normal equations anyway.
+        self.raw = None
+        if with_raw and dataset.raw is not None:
+            raw_np = np.where(np.isnan(dataset.raw), np.float32(0.0),
+                              dataset.raw).astype(np.float32)
+            if pad:
+                raw_np = np.pad(raw_np, ((0, 0), (0, pad)))
+            self.raw = jnp.asarray(raw_np)
+            h2d_xfers += 1
+            h2d_bytes += int(raw_np.nbytes)
         init = np.zeros((num_models, self.padded_rows), np.float32)
         if dataset.metadata.init_score is not None:
             init[:, :self.num_data] += np.asarray(
@@ -379,16 +410,24 @@ def _shared_gradients_fn(objective):
 
 
 def _build_shared_train_step(objective, num_class: int, guard: bool,
-                             kind: str, params: GrowParams):
+                             kind: str, params: GrowParams,
+                             linear: Optional[LinearParams] = None):
     """One fused boosting iteration as a PURE function of device arrays:
     gradients -> per-class grow -> score update -> packed host vectors.
     ``kind`` picks the serial growth strategy; the inner grow jits
-    inline under this trace (obs/compile_ledger.py passthrough)."""
+    inline under this trace (obs/compile_ledger.py passthrough).
+
+    ``linear`` (docs/LINEAR_TREES.md) appends the batched per-leaf
+    affine fit after each class's growth: the fitted intercepts replace
+    the grown leaf values, the fitted delta replaces the grower's
+    constant delta, and the packed transfer grows the (feat, coeff)
+    vectors.  ``linear=None`` leaves the trace — and the registry key —
+    byte-identical to the pre-linear program."""
     fused_comm = SerialComm(leaf_cache=False, fused_gain=True)
     nocache_comm = SerialComm(leaf_cache=False)
 
     def step_fn(score, feat_masks, row_weight, lr, bins, num_bin, is_cat,
-                grad_arrays, bins_rm, bins_words, bundle):
+                grad_arrays, bins_rm, bins_words, bundle, raw=None):
         grad, hess = objective.gradients_with(grad_arrays, score)
         ok = (_all_finite(grad, hess) if guard else jnp.asarray(True))
         outs = []
@@ -412,27 +451,56 @@ def _build_shared_train_step(objective, num_class: int, guard: bool,
             else:
                 ta, _, delta = grow_tree(*args, params, bins_rm=bins_rm,
                                          bundle=bundle)
-            score = score.at[cls].add(delta)
-            outs.append((pack_tree_arrays(ta), ta, delta))
+            if linear is not None:
+                ta, coeff, feat, delta, fb = fit_leaf_models(
+                    ta, bins, is_cat, raw, grad[cls], hess[cls],
+                    row_weight, lr, linear, bundle=bundle)
+                score = score.at[cls].add(delta)
+                outs.append((pack_tree_arrays(ta)
+                             + pack_linear(coeff, feat, fb),
+                             ta, delta, (coeff, feat)))
+            else:
+                score = score.at[cls].add(delta)
+                outs.append((pack_tree_arrays(ta), ta, delta))
         return score, outs, ok
     return step_fn
 
 
 def _shared_train_step(objective, num_class: int, guard: bool, kind: str,
-                       params: GrowParams, donate: bool):
+                       params: GrowParams, donate: bool,
+                       linear: Optional[LinearParams] = None):
     key = ("train_step", objective.program_key(), num_class, guard, kind,
-           params, donate)
+           params, donate, linear)
     holder = objective.program_holder()
     return _shared_jit(
         key,
         lambda: _build_shared_train_step(holder, num_class, guard,
-                                         kind, params),
+                                         kind, params, linear),
         program="train_step",
         # round-to-round state donation: the score cache is the only
         # argument that is dead after the call (the caller immediately
         # rebinds it to the output), so XLA may update it in place
         # instead of double-allocating [num_class, N] every iteration
         donate_argnums=(0,) if donate else ())
+
+
+def _shared_linear_fit(linear: LinearParams):
+    """Shared jitted program for the PER-STAGE path's batched leaf fit
+    (GOSS, custom fobj, LGBT_NO_FUSED_STEP — the fused path inlines
+    fit_leaf_models into train_step instead).  Keyed on the static
+    LinearParams alone: every per-dataset array travels as an argument,
+    so rebuilt boosters reuse the compiled program."""
+    def make():
+        def fit(tree_arrays, bins, is_cat, raw, grad, hess, row_weight,
+                lr, bundle):
+            return fit_leaf_models(tree_arrays, bins, is_cat, raw, grad,
+                                   hess, row_weight, lr, linear,
+                                   bundle=bundle)
+        return fit
+    return _shared_jit(("linear_fit", linear), make, program="linear_fit")
+
+
+_PACK_LINEAR = obs.instrumented_jit(pack_linear, program="pack_tree")
 
 
 class GBDT:
@@ -459,6 +527,9 @@ class GBDT:
     _screener = None              # models/screening.py GainScreener
     _screen_mask_dev = None
     _parallel_grow_active = False
+    # -- piece-wise linear trees (models/linear.py, docs/LINEAR_TREES.md;
+    # None = constant leaves, the default) ------------------------------
+    _linear: Optional[LinearParams] = None
     # -- telemetry (lightgbm_tpu/obs/; all optional, None/zero = off) ----
     _telemetry = None             # obs.EventRecorder (set_event_recorder)
     _trace = None                 # obs.TraceCapture window (env/config)
@@ -519,10 +590,12 @@ class GBDT:
                              if self._row_buckets_enabled(cfg)
                              and not self.objective.uses_legacy_gradients()
                              else self.num_data)
+        self._linear = self._setup_linear(cfg, train_set)
         self._check_memory_budget(cfg, train_set)
         self.train_data = _DeviceData(train_set, self.num_class,
                                       with_row_major=True,
-                                      padded_rows=self._padded_rows)
+                                      padded_rows=self._padded_rows,
+                                      with_raw=self._linear is not None)
         self.valid_data: List[_DeviceData] = []
         self.valid_metrics: List[List[Metric]] = []
         self.train_metrics = self._make_metrics(cfg, train_set)
@@ -594,6 +667,46 @@ class GBDT:
             refresh=int(getattr(cfg, "feature_screen_refresh", 10) or 10),
             warmup=int(getattr(cfg, "feature_screen_warmup", 20) or 0),
             decay=float(getattr(cfg, "feature_screen_decay", 0.9) or 0.9))
+
+    def _setup_linear(self, cfg: Config,
+                      train_set: BinnedDataset) -> Optional[LinearParams]:
+        """Piece-wise linear leaf config (models/linear.py,
+        docs/LINEAR_TREES.md), or None when the subsystem is off/inert.
+        Unsupportable combinations REFUSE with a named error instead of
+        silently training a different model."""
+        if not bool(getattr(cfg, "linear_tree", False)):
+            return None
+        k = int(getattr(cfg, "linear_max_leaf_features", 0) or 0)
+        if k <= 0:
+            # the documented degenerate case: zero covariate slots means
+            # constant leaves — the whole subsystem stays inert, so the
+            # run is bit/ledger-identical to linear_tree=false
+            log.warn_once(
+                "linear_tree_k0",
+                "linear_tree=true with linear_max_leaf_features=0: "
+                "leaves stay constant (the linear subsystem is inert "
+                "and output is identical to linear_tree=false)")
+            return None
+        parallel = bool(getattr(cfg, "is_parallel", False))
+        try:
+            parallel = parallel or jax.process_count() > 1
+        except Exception:  # pragma: no cover - uninitialized backend
+            pass
+        if parallel:
+            raise LightGBMError(
+                "linear_tree is not supported with distributed training "
+                "(the per-leaf ridge solve needs the full raw feature "
+                "matrix on one device); use tree_learner=serial on a "
+                "single process, or set linear_tree=false")
+        if train_set.raw is None:
+            raise LightGBMError(
+                "linear_tree requires the raw feature values, but this "
+                "dataset carries none (streamed ingest, or a binary "
+                "file saved without linear_tree).  Rebuild the Dataset "
+                "from an in-memory matrix with linear_tree=true in its "
+                "params, or re-save the binary with it")
+        return LinearParams(k, float(cfg.linear_lambda),
+                            float(cfg.lambda_l2))
 
     def _make_full_view(self) -> _HistView:
         td = self.train_data
@@ -682,7 +795,9 @@ class GBDT:
             bin_itemsize=train_set.bins.dtype.itemsize,
             donate_score=not guard and self._donation_on(),
             fused_scratch=fused,
-            leaf_cache=not fused and not self._degrade_leaf_cache_off)
+            leaf_cache=not fused and not self._degrade_leaf_cache_off,
+            linear_k=(self._linear.max_features
+                      if self._linear is not None else 0))
 
     def _donation_on(self) -> bool:
         """This booster's round-to-round donation decision (before the
@@ -831,7 +946,9 @@ class GBDT:
             bin_itemsize=train_set.bins.dtype.itemsize,
             donate_score=not guard and self._donation_on(),
             fused_scratch=fused,
-            leaf_cache=not fused and not self._degrade_leaf_cache_off)
+            leaf_cache=not fused and not self._degrade_leaf_cache_off,
+            linear_k=(self._linear.max_features
+                      if self._linear is not None else 0))
 
     @staticmethod
     def _make_grow_params(cfg: Config) -> GrowParams:
@@ -1027,11 +1144,13 @@ class GBDT:
         # valid-set accounting survives the gate's reset (valid sets
         # are not touched by a training-data swap).
         valid_bytes = getattr(self, "_valid_mem_bytes", 0)
+        self._linear = self._setup_linear(cfg, train_set)
         self._check_memory_budget(cfg, train_set)
         self._valid_mem_bytes = valid_bytes
         self.train_data = _DeviceData(train_set, self.num_class,
                                       with_row_major=True,
-                                      padded_rows=self._padded_rows)
+                                      padded_rows=self._padded_rows,
+                                      with_raw=self._linear is not None)
         self.train_metrics = self._make_metrics(cfg, train_set)
         self._init_row_state()
         self._full_feat_mask = jnp.ones(self.num_features, bool)
@@ -1082,11 +1201,17 @@ class GBDT:
                 getattr(self, "_train_mem_est", 0) / (1 << 20),
                 valid_bytes / (1 << 20))
         self._valid_mem_bytes = valid_bytes
+        if self._linear is not None and valid_set.raw is None:
+            log.fatal("linear_tree validation scoring needs the valid "
+                      "set's raw feature values (the per-leaf affine "
+                      "epilogue reads them); create the valid set with "
+                      "reference=train from an in-memory matrix")
         dd = _DeviceData(valid_set, self.num_class,
                          padded_rows=(
                              compile_cache.bucket_rows(valid_set.num_data)
                              if self._row_buckets_enabled(self.config)
-                             else valid_set.num_data))
+                             else valid_set.num_data),
+                         with_raw=self._linear is not None)
         # replay existing trees (continued training)
         for i, tree in enumerate(self.models):
             cls = i % self.num_class
@@ -1276,14 +1401,16 @@ class GBDT:
             return self._make_train_step_local(guard)
         jit = _shared_train_step(self.objective, self.num_class, guard,
                                  self._serial_grow_kind(), self.grow_params,
-                                 donate=not guard and self._donation_on())
+                                 donate=not guard and self._donation_on(),
+                                 linear=self._linear)
         num_bin, is_cat = self.num_bin, self.is_cat
         grad_arrays = self._grad_arrays
+        raw = self.train_data.raw if self._linear is not None else None
 
         def step(score, feat_masks, row_weight, lr, view):
             return jit(score, feat_masks, row_weight, lr, view.bins,
                        num_bin, is_cat, grad_arrays, view.bins_rm,
-                       view.bins_words, view.bundle)
+                       view.bins_words, view.bundle, raw)
         return step
 
     def _make_train_step_local(self, guard: bool):
@@ -1338,13 +1465,26 @@ class GBDT:
             host = jax.device_get([packed for packed, _, _ in pend])
         obs.devprof.transfer(
             "d2h", "host_tree",
-            sum(int(iv.nbytes) + int(fv.nbytes) for iv, fv in host))
+            sum(int(a.nbytes) for vecs in host for a in vecs))
         L = self.grow_params.num_leaves
-        trees = [Tree.from_arrays(unpack_tree_arrays(iv, fv, L),
-                                  self.train_set.mappers,
-                                  self.train_set.used_feature_map,
-                                  self._pending_shrinkage)
-                 for iv, fv in host]
+        lin = self._linear
+        trees = []
+        for vecs in host:
+            tree = Tree.from_arrays(
+                unpack_tree_arrays(vecs[0], vecs[1], L),
+                self.train_set.mappers,
+                self.train_set.used_feature_map,
+                self._pending_shrinkage)
+            if len(vecs) > 2 and lin is not None:
+                # linear transport rides the SAME device_get: two more
+                # packed vectors per class (models/linear.py)
+                coeff, feat, fb = unpack_linear(vecs[2], vecs[3], L,
+                                                lin.max_features)
+                attach_linear(tree, coeff, feat,
+                              self.train_set.used_feature_map)
+                if fb:
+                    obs.inc("linear_fallback_total", fb)
+            trees.append(tree)
         if self._screener is not None:
             # realized split gains feed the EMA-FS feature EWMA
             # (models/screening.py); 1-leaf saturated trees contribute
@@ -1649,11 +1789,17 @@ class GBDT:
                 elif not bool(ok_sc):
                     poisoned = "scores"
             if poisoned is None:
-                for cls, (packed, tree_arrays, delta) in enumerate(outs):
+                for cls, out in enumerate(outs):
+                    # linear steps append (coeff, feat) as a 4th element
+                    # (docs/LINEAR_TREES.md) — the valid replay epilogue
+                    # needs them
+                    packed, tree_arrays, delta = out[0], out[1], out[2]
+                    lin = out[3] if len(out) > 3 else None
                     vdeltas = []
                     with timetag.scope("GBDT::valid_score") as tt:
                         for dd in self.valid_data:
-                            vd = self._device_tree_delta(dd, tree_arrays)
+                            vd = self._device_tree_delta(dd, tree_arrays,
+                                                         lin)
                             dd.score = self._score_add(dd.score, vd,
                                                        cls, donate)
                             vdeltas.append(vd)
@@ -1697,6 +1843,19 @@ class GBDT:
                         view, self.num_bin, self.is_cat,
                         feat_mask, grad[cls], hess[cls], row_weight, lr_dev)
                     tt.sync(delta)
+                lin = None
+                if self._linear is not None:
+                    # batched per-leaf affine fit (models/linear.py):
+                    # intercepts replace the grown leaf values and the
+                    # fitted delta replaces the grower's constant delta
+                    with timetag.scope("Bin::linear_fit") as tt:
+                        (tree_arrays, l_coeff, l_feat, delta,
+                         l_fb) = _shared_linear_fit(self._linear)(
+                            tree_arrays, view.bins, self.is_cat,
+                            self.train_data.raw, grad[cls], hess[cls],
+                            row_weight, lr_dev, view.bundle)
+                        lin = (l_coeff, l_feat)
+                        tt.sync(delta)
                 with timetag.scope("GBDT::train_score") as tt:
                     self.train_data.score = self._score_add(
                         self.train_data.score, delta, cls, donate)
@@ -1704,12 +1863,16 @@ class GBDT:
                 vdeltas = []
                 with timetag.scope("GBDT::valid_score") as tt:
                     for dd in self.valid_data:
-                        vd = self._device_tree_delta(dd, tree_arrays)
+                        vd = self._device_tree_delta(dd, tree_arrays, lin)
                         dd.score = self._score_add(dd.score, vd, cls,
                                                    donate)
                         vdeltas.append(vd)
                     tt.sync(vdeltas)
-                cur.append((_PACK_TREE(tree_arrays), delta, vdeltas))
+                packed = _PACK_TREE(tree_arrays)
+                if lin is not None:
+                    packed = tuple(packed) + tuple(
+                        _PACK_LINEAR(l_coeff, l_feat, l_fb))
+                cur.append((packed, delta, vdeltas))
             if guard and poisoned is None \
                     and not bool(_all_finite(self.train_data.score)):
                 # finite gradients can still yield a non-finite tree
@@ -1937,13 +2100,18 @@ class GBDT:
             self._active_view = None
 
     # ------------------------------------------------------------------
-    def _device_tree_delta(self, dd: _DeviceData, tree_arrays) -> jax.Array:
-        delta, _ = predict_binned_tree(
+    def _device_tree_delta(self, dd: _DeviceData, tree_arrays,
+                           lin=None) -> jax.Array:
+        delta, leaf = predict_binned_tree(
             tree_arrays.split_feature, tree_arrays.split_bin,
             self.is_cat[jnp.maximum(tree_arrays.split_feature, 0)],
             tree_arrays.left_child, tree_arrays.right_child,
             tree_arrays.leaf_value, dd.bins,
             self.grow_params.num_leaves, bundle=self._bundle)
+        if lin is not None:
+            # per-leaf affine epilogue (models/linear.py); ``lin`` is the
+            # device (coeff [L, K], feat [L, K] inner-index) pair
+            delta = delta + affine_epilogue(leaf, lin[0], lin[1], dd.raw)
         return delta
 
     def _add_host_tree_to(self, dd: _DeviceData, tree: Tree, cls: int):
@@ -1957,14 +2125,42 @@ class GBDT:
                                  self.train_set.mappers):
             log.fatal("Cannot replay a loaded tree on this dataset: it "
                       "splits on a feature the dataset binned as trivial")
-        delta, _ = predict_binned_tree(
+        delta, leaf = predict_binned_tree(
             jnp.asarray(tree.split_feature_inner),
             jnp.asarray(tree.threshold_in_bin),
             jnp.asarray(tree.decision_type == 1),
             jnp.asarray(tree.left_child), jnp.asarray(tree.right_child),
             jnp.asarray(tree.leaf_value, jnp.float32), dd.bins,
             int(tree.num_leaves), bundle=self._bundle)
+        if tree.has_linear():
+            if dd.raw is None:
+                log.fatal("Cannot replay a linear tree on this dataset: "
+                          "no raw feature values are resident (build the "
+                          "booster with linear_tree=true so the device "
+                          "raw copy is uploaded)")
+            inner = self._linear_inner_feat(tree)
+            delta = delta + affine_epilogue(
+                leaf, jnp.asarray(tree.leaf_coeff, jnp.float32),
+                jnp.asarray(inner), dd.raw)
         dd.score = dd.score.at[cls].add(delta)
+
+    def _linear_inner_feat(self, tree: Tree) -> np.ndarray:
+        """A linear tree's leaf_feat (REAL feature indices, like
+        split_feature) mapped into the training dataset's inner used-
+        feature space — what the device raw matrix is indexed by.
+        Refuses when an affine model reads a feature this dataset
+        binned as trivial (there is no raw column to read)."""
+        r2i = np.asarray(self.train_set.real_to_inner, np.int64)
+        lf = np.asarray(tree.leaf_feat, np.int64)
+        inner = np.where(lf >= 0, r2i[np.maximum(lf, 0)], -1)
+        bad = (lf >= 0) & (inner < 0) \
+            & (np.asarray(tree.leaf_coeff) != 0.0)
+        if np.any(bad):
+            log.fatal("Cannot replay a linear tree on this dataset: a "
+                      "leaf's affine model reads feature(s) %s, which "
+                      "the dataset binned as trivial",
+                      sorted(set(lf[bad].tolist())))
+        return inner.astype(np.int32)
 
     # ------------------------------------------------------------------
     def eval_and_check_early_stopping(self) -> bool:
@@ -2067,12 +2263,29 @@ class GBDT:
                 and self.train_set.mappers
                 and all(t.ensure_inner(self.train_set.real_to_inner,
                                        self.train_set.mappers)
-                        for t in self.models[:n_models])):
+                        for t in self.models[:n_models])
+                and self._linear_device_ok(n_models)):
             return self._predict_raw_device(X, n_models)
         out = np.zeros((self.num_class, X.shape[0]), np.float64)
         for i in range(n_models):
             out[i % self.num_class] += self.models[i].predict(X)
         return out
+
+    def _linear_device_ok(self, n_models: int) -> bool:
+        """Device batch predict serves linear trees only when every
+        affine feature maps into this dataset's inner (used-feature)
+        space — the device raw matrix has no column for a trivially
+        binned feature.  Unmappable models take the host walk, which
+        reads REAL indices directly."""
+        r2i = np.asarray(self.train_set.real_to_inner, np.int64)
+        for t in self.models[:n_models]:
+            if not t.has_linear():
+                continue
+            lf = np.asarray(t.leaf_feat, np.int64)
+            used = (lf >= 0) & (np.asarray(t.leaf_coeff) != 0.0)
+            if np.any(used & (r2i[np.maximum(lf, 0)] < 0)):
+                return False
+        return True
 
     def _predict_raw_device(self, X: np.ndarray, n_models: int) -> np.ndarray:
         ts = self.train_set
@@ -2103,12 +2316,26 @@ class GBDT:
         ladder = BucketLadder(
             list(getattr(self.config, "predict_buckets", []) or []) or None)
         counting = _counting_forest_jit()
+        # linear forests also ship the raw f32 covariates per chunk
+        # (NaN imputed to 0.0, exactly the training upload's policy)
+        linear = any(t.has_linear() for t in self.models[:n_models])
+        raw_np = None
+        if linear:
+            Xr = X[:, list(ts.used_feature_map)].T.astype(np.float32)
+            raw_np = np.where(np.isnan(Xr), np.float32(0.0), Xr)
         dev_chunks = []
         for off, m, bucket in ladder.chunks(n):
             bpad = np.zeros((bins_np.shape[0], bucket), np.int32)
             bpad[:, :m] = bins_np[:, off:off + m]
-            dev_chunks.append((off, m, bucket, jnp.asarray(bpad)))
-            obs.devprof.transfer("h2d", "predict", int(bpad.nbytes))
+            rdev = None
+            nbytes = int(bpad.nbytes)
+            if linear:
+                rpad = np.zeros((raw_np.shape[0], bucket), np.float32)
+                rpad[:, :m] = raw_np[:, off:off + m]
+                rdev = jnp.asarray(rpad)
+                nbytes += int(rpad.nbytes)
+            dev_chunks.append((off, m, bucket, jnp.asarray(bpad), rdev))
+            obs.devprof.transfer("h2d", "predict", nbytes)
         # continued training may hold trees larger than grow_params allows
         L = max(max(t.num_leaves for t in self.models[:n_models]), 2)
         out = np.zeros((self.num_class, n), np.float64)
@@ -2123,6 +2350,10 @@ class GBDT:
             lc = np.zeros((T, max(L - 1, 1)), np.int32)
             rc = np.zeros((T, max(L - 1, 1)), np.int32)
             lv = np.zeros((T, L), np.float32)
+            kf = (max([t.leaf_feat.shape[1] for t in trees
+                       if t.has_linear()] or [1]) if linear else 0)
+            lcf = np.zeros((T, L, max(kf, 1)), np.float32)
+            lft = np.full((T, L, max(kf, 1)), -1, np.int32)
             for t, tree in enumerate(trees):
                 k = tree.num_leaves - 1
                 if k <= 0:
@@ -2137,11 +2368,23 @@ class GBDT:
                 lc[t, :k] = tree.left_child
                 rc[t, :k] = tree.right_child
                 lv[t, :tree.num_leaves] = tree.leaf_value
+                if linear and tree.has_linear():
+                    nl, tk = tree.leaf_coeff.shape
+                    lcf[t, :nl, :tk] = tree.leaf_coeff
+                    lft[t, :nl, :tk] = self._linear_inner_feat(tree)
             args = (jnp.asarray(sf), jnp.asarray(sb), jnp.asarray(ic),
                     jnp.asarray(lc), jnp.asarray(rc), jnp.asarray(lv))
-            for off, m, bucket, bdev in dev_chunks:
-                val = counting(bucket, *args, bdev, max_steps=L)
-                out[cls, off:off + m] = np.asarray(val, np.float64)[:m]
+            if linear:
+                lin_args = (jnp.asarray(lcf), jnp.asarray(lft))
+                counting_lin = _counting_forest_linear_jit()
+                for off, m, bucket, bdev, rdev in dev_chunks:
+                    val = counting_lin(bucket, *args, *lin_args, bdev,
+                                       rdev, max_steps=L)
+                    out[cls, off:off + m] = np.asarray(val, np.float64)[:m]
+            else:
+                for off, m, bucket, bdev, _ in dev_chunks:
+                    val = counting(bucket, *args, bdev, max_steps=L)
+                    out[cls, off:off + m] = np.asarray(val, np.float64)[:m]
         return out
 
     def predict(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
@@ -2356,6 +2599,22 @@ def _counting_forest_jit():
     return _COUNTING_FOREST_JIT
 
 
+_COUNTING_FOREST_LINEAR_JIT = None
+
+
+def _counting_forest_linear_jit():
+    """Linear-forest twin of ``_counting_forest_jit``: one process-wide
+    compile-counting wrapper around ``predict_binned_forest_linear``.
+    A separate entry point so constant-leaf predict keeps its exact
+    pre-linear program (docs/LINEAR_TREES.md)."""
+    global _COUNTING_FOREST_LINEAR_JIT
+    if _COUNTING_FOREST_LINEAR_JIT is None:
+        from ..serve.batcher import CountingJit
+        _COUNTING_FOREST_LINEAR_JIT = CountingJit(
+            predict_binned_forest_linear, "predict_forest")
+    return _COUNTING_FOREST_LINEAR_JIT
+
+
 def _mappers_aligned(a: BinnedDataset, b: BinnedDataset) -> bool:
     """True when two datasets share identical bin mappers (feature map,
     bin counts, and boundaries) — Dataset::CheckAlign equivalent.  With
@@ -2381,10 +2640,10 @@ def _mappers_aligned(a: BinnedDataset, b: BinnedDataset) -> bool:
 
 
 def _negate_tree(tree: Tree) -> Tree:
-    import copy
-    neg = copy.deepcopy(tree)
-    neg.leaf_value = -neg.leaf_value
-    return neg
+    """Copy with every leaf OUTPUT negated (DART drop / rollback replay).
+    Routed through the single leaf-mutation point so affine leaves
+    negate their slopes too (docs/LINEAR_TREES.md)."""
+    return tree.scaled_copy(-1.0)
 
 
 class _PredictionObjective(ObjectiveFunction):

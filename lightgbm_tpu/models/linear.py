@@ -1,0 +1,240 @@
+"""Piece-wise linear trees: batched per-leaf affine fits on device.
+
+After the histogram path grows a tree's STRUCTURE, every leaf gets an
+affine model ``value(x) = const + sum_k coeff[k] * x[feat[k]]`` over up
+to K = ``linear_max_leaf_features`` features drawn from the leaf's own
+root path ("Gradient Boosting With Piece-Wise Linear Regression Trees",
+PAPERS.md: path features are the natural, already-selected candidates).
+The fit minimizes the same second-order objective the constant leaf
+minimizes — for leaf ``l`` with rows ``i`` (``g/h`` already
+row_weight-scaled, exactly the grower's inputs):
+
+    min_w  sum_i [ g_i * phi_i^T w + 0.5 * h_i * (phi_i^T w)^2 ]
+           + 0.5 * linear_lambda * |w_1..K|^2 + 0.5 * lambda_l2 * w_0^2
+
+with ``phi_i = [x_i[f_1] ... x_i[f_K], 1]``, i.e. the normal equations
+``(A + diag(ridge)) w = b`` where ``A = sum h_i phi phi^T`` and
+``b = -sum g_i phi``.  All L leaves solve in ONE batched Cholesky over
+``[L, K+1, K+1]`` — a fleet of tiny MXU-shaped solves, not a host loop.
+
+Shapes are STATIC: K is a compile-time pad width (leaves with shorter
+paths carry ``feat = -1`` slots whose normal-equation row/col is pinned
+to the identity so their coefficient solves to exactly 0).  One shared
+program per (K, lambda) config — the PR 7 registry stays warm and the
+compile ledger records zero new programs after warmup.
+
+Fallbacks (counted as ``linear_fallback_total``): a leaf whose solve is
+non-finite (singular / ill-conditioned) or that holds fewer than K + 2
+in-bag rows keeps its constant grown value (coeff = 0), so a fully
+degenerate run is bit-identical to ``linear_tree=false``.
+
+NaN policy: raw values are imputed to 0.0 at fit AND predict time (the
+device raw upload pre-imputes), so train/serve agree exactly.
+Categorical path features are skipped (an equality split's code is not a
+regression covariate).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.predict import predict_binned_tree
+
+
+class LinearParams(NamedTuple):
+    """Static linear-tree config (hashable: part of shared-program jit
+    keys, like ops/grow.py GrowParams)."""
+    max_features: int       # K: padded path-feature slots per leaf
+    lambda_: float          # ridge on the K slope terms (linear_lambda)
+    lambda_l2: float        # ridge on the intercept (grow's lambda_l2)
+
+
+def path_features(tree_arrays, is_cat, max_features: int):
+    """[L, K] per-leaf path features (inner indices, -1 pad), on device.
+
+    For each leaf: walk parents root-ward from ``leaf_parent``,
+    collecting each ancestor's split feature nearest-to-leaf first,
+    dropping categorical features and duplicates (first occurrence
+    wins), keeping the first K unique.  Everything is fixed-shape: the
+    walk is a scan of L-1 steps and the dedup is an [L, D, D] pairwise
+    compare (D = L-1 is small — num_leaves is O(100)).
+    """
+    ta = tree_arrays
+    L = ta.leaf_value.shape[0]
+    K = int(max_features)
+    if K <= 0 or L < 2:
+        return jnp.full((L, max(K, 0)), -1, jnp.int32)
+    n = L - 1
+    idx = jnp.arange(n, dtype=jnp.int32)
+    # internal-node parent pointers, scattered from the child arrays
+    # (children >= 0 are internal nodes; ~leaf targets go to the OOB
+    # dump slot and are dropped)
+    intp = jnp.full(n, -1, jnp.int32)
+    intp = intp.at[jnp.where(ta.left_child >= 0, ta.left_child, n)].set(
+        idx, mode="drop")
+    intp = intp.at[jnp.where(ta.right_child >= 0, ta.right_child, n)].set(
+        idx, mode="drop")
+    # per-node candidate feature (-1 for categorical splits)
+    node_cat = is_cat[jnp.maximum(ta.split_feature, 0)]
+    node_feat = jnp.where(node_cat, -1, ta.split_feature).astype(jnp.int32)
+
+    def step(cur, _):
+        live = cur >= 0
+        safe = jnp.minimum(jnp.maximum(cur, 0), n - 1)
+        f = jnp.where(live, node_feat[safe], -1)
+        nxt = jnp.where(live, intp[safe], -1)
+        return nxt, f
+
+    # feats[d, l]: the d-th ancestor's feature, leaf-nearest first
+    _, feats = jax.lax.scan(step, ta.leaf_parent.astype(jnp.int32),
+                            None, length=n)
+    feats = feats.T                                   # [L, D]
+    # first-occurrence dedup: slot d is a duplicate if an earlier slot
+    # e < d holds the same (valid) feature
+    eq = feats[:, :, None] == feats[:, None, :]       # [L, D, D]
+    earlier = jnp.tril(jnp.ones((n, n), bool), k=-1)  # [d, e] with e < d
+    dup = (eq & earlier[None, :, :]).any(axis=2)
+    occ = (feats >= 0) & ~dup
+    rank = jnp.cumsum(occ.astype(jnp.int32), axis=1) - 1
+    slot = jnp.where(occ & (rank < K), rank, K)       # K = dump slot
+    out = jnp.full((L, K + 1), -1, jnp.int32)
+    out = out.at[jnp.arange(L)[:, None], slot].set(feats, mode="drop")
+    return out[:, :K]
+
+
+def gather_leaf_values(raw, feat, leaf):
+    """[N, K] raw covariates for each row's leaf: ``raw[feat[leaf]]``
+    with -1 pad slots zeroed.  ``raw`` is [F_used, N] f32 NaN-imputed."""
+    f_row = feat[leaf]                                # [N, K]
+    n = raw.shape[1]
+    vals = raw[jnp.maximum(f_row, 0), jnp.arange(n)[:, None]]
+    return jnp.where(f_row >= 0, vals, 0.0)
+
+
+def affine_epilogue(leaf, coeff, feat, raw):
+    """[N] per-row affine part ``sum_k coeff[leaf, k] * x[feat[leaf, k]]``
+    — added onto the constant leaf walk by every replay/predict path."""
+    vals = gather_leaf_values(raw, feat, leaf)
+    return (coeff[leaf] * vals).sum(axis=1)
+
+
+def fit_leaf_models(tree_arrays, bins, is_cat, raw, grad, hess,
+                    row_weight, lr, linear: LinearParams, bundle=None):
+    """Fit every leaf's affine model in one batched solve.
+
+    Returns ``(new_tree_arrays, coeff [L, K] f32, feat [L, K] i32,
+    delta [N] f32, fallback_count i32)``: tree_arrays with
+    ``leaf_value`` replaced by the (shrunk) fitted intercepts, the
+    lr-scaled slope table, the per-leaf feature table (inner indices,
+    -1 pad), the per-row score delta REPLACING the grower's constant
+    delta, and the number of active leaves that fell back.
+
+    ``grad``/``hess`` are the same per-row arrays the grower consumed
+    (NOT yet row_weight-scaled; the weights ride in ``row_weight``, so
+    pad rows and out-of-bag rows contribute nothing to the sums, exactly
+    how bagging excludes them from histograms).  ``lr`` scales the
+    solution like the grower shrinks leaf values, so downstream scaling
+    (scale_leaf_outputs) treats const and coeff identically.
+    """
+    ta = tree_arrays
+    L = ta.leaf_value.shape[0]
+    K = int(linear.max_features)
+    M = K + 1
+    with jax.named_scope("linear_fit"):
+        # leaf assignment by re-walking the grown structure over the
+        # training bins: covers out-of-bag rows (zero-weight, but they
+        # still need their DELTA) and stays correct under any grower
+        _, leaf = predict_binned_tree(
+            ta.split_feature, ta.split_bin,
+            is_cat[jnp.maximum(ta.split_feature, 0)],
+            ta.left_child, ta.right_child, ta.leaf_value,
+            bins, L, bundle=bundle)
+        feat = path_features(ta, is_cat, K)
+        vals = gather_leaf_values(raw, feat, leaf)    # [N, K]
+        g = grad * row_weight
+        h = hess * row_weight
+        one = jnp.ones_like(g)
+        phi = jnp.concatenate([vals, one[:, None]], axis=1)  # [N, M]
+        # normal equations via M*(M+1)/2 segment-sums of [N] products —
+        # never materializes the [N, M, M] outer-product tensor
+        A = jnp.zeros((L, M, M), jnp.float32)
+        for i in range(M):
+            for j in range(i, M):
+                s = jax.ops.segment_sum(h * phi[:, i] * phi[:, j],
+                                        leaf, num_segments=L)
+                A = A.at[:, i, j].set(s)
+                if i != j:
+                    A = A.at[:, j, i].set(s)
+        b = jnp.stack([jax.ops.segment_sum(-g * phi[:, i], leaf,
+                                           num_segments=L)
+                       for i in range(M)], axis=1)    # [L, M]
+        cnt = jax.ops.segment_sum((row_weight > 0).astype(jnp.int32),
+                                  leaf, num_segments=L)
+        # ridge + pad pinning: a -1 slot's row/col is all zero (its phi
+        # column is zero), so a unit diagonal pins its solution to
+        # exactly 0 while keeping A positive definite
+        active_slot = feat >= 0                       # [L, K]
+        diag = jnp.concatenate(
+            [jnp.where(active_slot, jnp.float32(linear.lambda_), 1.0),
+             jnp.full((L, 1), jnp.float32(linear.lambda_l2))], axis=1)
+        rng = jnp.arange(M)
+        A = A.at[:, rng, rng].add(diag)
+        chol = jnp.linalg.cholesky(A)                 # NaN where not PD
+        y = jax.scipy.linalg.solve_triangular(chol, b[..., None],
+                                              lower=True)
+        w = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(chol, -1, -2), y, lower=False)[..., 0]  # [L, M]
+        # fallback: non-finite solve (singular) or min_data starvation
+        # (need more in-bag rows than unknowns)
+        active_leaf = jnp.arange(L) < ta.num_leaves
+        use_lin = (jnp.isfinite(w).all(axis=1) & (cnt >= K + 2)
+                   & active_leaf)
+        fallback_count = jnp.where(
+            ta.num_leaves > 1,
+            (active_leaf & ~use_lin).sum().astype(jnp.int32),
+            jnp.int32(0))
+        coeff = jnp.where(use_lin[:, None] & active_slot,
+                          lr * w[:, :K], 0.0).astype(jnp.float32)
+        const = jnp.where(use_lin, lr * w[:, K],
+                          ta.leaf_value).astype(jnp.float32)
+        delta = const[leaf] + (coeff[leaf] * vals).sum(axis=1)
+        new_ta = ta._replace(leaf_value=const)
+        return new_ta, coeff, feat, delta, fallback_count
+
+
+def pack_linear(coeff, feat, fallback_count):
+    """(ints, flts) flat transfer vectors — ride the same single
+    device_get as pack_tree_arrays' vectors (models/gbdt.py
+    _flush_pending)."""
+    ints = jnp.concatenate([feat.ravel(),
+                            fallback_count.reshape(1)]).astype(jnp.int32)
+    return ints, coeff.ravel().astype(jnp.float32)
+
+
+def unpack_linear(ints, flts, num_leaves_padded: int, max_features: int):
+    """Host inverse of pack_linear: (coeff [L, K], feat [L, K],
+    fallback_count)."""
+    import numpy as np
+    L, K = int(num_leaves_padded), int(max_features)
+    feat = np.asarray(ints[:L * K], np.int32).reshape(L, K)
+    fb = int(ints[L * K])
+    coeff = np.asarray(flts[:L * K], np.float64).reshape(L, K)
+    return coeff, feat, fb
+
+
+def attach_linear(tree, coeff, feat, used_feature_map):
+    """Attach host linear arrays to a Tree, mapping inner feature
+    indices to REAL indices (like Tree.from_arrays does for splits).
+    Crops to the tree's real leaf count."""
+    import numpy as np
+    nl = int(tree.num_leaves)
+    coeff = np.asarray(coeff, np.float64)[:nl]
+    feat = np.asarray(feat, np.int32)[:nl]
+    ufm = np.asarray(list(used_feature_map) + [0], np.int64)
+    real = np.where(feat >= 0, ufm[np.maximum(feat, 0)], -1)
+    tree.leaf_coeff = coeff
+    tree.leaf_feat = real.astype(np.int32)
+    return tree

@@ -38,6 +38,15 @@ def _fmt_int_arr(arr) -> str:
 class Tree:
     """A trained decision tree (host representation)."""
 
+    # piece-wise linear leaves (models/linear.py, docs/LINEAR_TREES.md):
+    # when set, leaf l predicts
+    #   leaf_value[l] + sum_k leaf_coeff[l, k] * x[leaf_feat[l, k]]
+    # (leaf_feat holds REAL feature indices, -1 = unused pad slot; NaN
+    # inputs read as 0.0).  Class-level None so old pickles/snapshots
+    # deserialize as constant-leaf trees.
+    leaf_coeff: Optional[np.ndarray] = None   # [num_leaves, K] float64
+    leaf_feat: Optional[np.ndarray] = None    # [num_leaves, K] int32
+
     def __init__(self, num_leaves: int):
         self.num_leaves = num_leaves
         n = max(num_leaves - 1, 0)
@@ -133,6 +142,24 @@ class Tree:
         return True
 
     # ------------------------------------------------------------------
+    def has_linear(self) -> bool:
+        """True when this tree carries a non-trivial affine part.  A
+        linear fit where every leaf fell back (all-zero coefficients) is
+        semantically a constant tree — and must SERIALIZE as one, so a
+        fully degenerate linear run stays byte-identical to
+        ``linear_tree=false`` (docs/LINEAR_TREES.md)."""
+        return (self.leaf_coeff is not None and self.leaf_coeff.size > 0
+                and bool(np.any(self.leaf_coeff != 0.0)))
+
+    def _affine_part(self, X: np.ndarray, leaf_idx: np.ndarray) -> np.ndarray:
+        """Per-row affine contribution for rows resolved to
+        ``leaf_idx``.  NaN covariates read as 0.0 — the same imputation
+        the device fit/predict paths apply (models/linear.py)."""
+        lf = self.leaf_feat[leaf_idx]                       # [n, K]
+        vals = X[np.arange(X.shape[0])[:, None], np.maximum(lf, 0)]
+        vals = np.where((lf >= 0) & ~np.isnan(vals), vals, 0.0)
+        return (self.leaf_coeff[leaf_idx] * vals).sum(axis=1)
+
     def predict(self, X: np.ndarray) -> np.ndarray:
         """Raw-value prediction, vectorized node walk (tree.h:197-227)."""
         n = X.shape[0]
@@ -141,6 +168,8 @@ class Tree:
         node = np.zeros(n, dtype=np.int32)
         active = np.ones(n, dtype=bool)
         out = np.zeros(n, dtype=np.float64)
+        linear = self.leaf_coeff is not None and self.leaf_coeff.size > 0
+        leaf_idx = np.zeros(n, dtype=np.int64) if linear else None
         for _ in range(self.num_leaves):  # max depth bound
             if not active.any():
                 break
@@ -156,7 +185,11 @@ class Tree:
             node = node_active
             arrived = active & (node < 0)
             out[arrived] = self.leaf_value[~node[arrived]]
+            if linear:
+                leaf_idx[arrived] = ~node[arrived]
             active = active & (node >= 0)
+        if linear:
+            out = out + self._affine_part(X, leaf_idx)
         return out
 
     def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
@@ -192,20 +225,32 @@ class Tree:
                     best = max(best, depth[node] + 1)
         return best
 
+    def scale_leaf_outputs(self, factor: float) -> "Tree":
+        """Scale EVERY leaf output by ``factor``, in place — the single
+        mutation point for leaf values (Tree::Shrinkage).  Scales the
+        constant values, the affine coefficients (an affine leaf's
+        output is ``const + coeff . x``, so both terms scale together —
+        a half-scaled linear leaf would silently corrupt DART
+        normalization and merge decay), ``internal_value`` and the
+        recorded ``shrinkage`` so the text serialization stays
+        self-consistent.  Returns self."""
+        f = float(factor)
+        if f == 1.0:
+            return self
+        self.leaf_value = np.asarray(self.leaf_value, np.float64) * f
+        if self.leaf_coeff is not None:
+            self.leaf_coeff = np.asarray(self.leaf_coeff, np.float64) * f
+        self.internal_value = np.asarray(self.internal_value,
+                                         np.float64) * f
+        self.shrinkage = float(self.shrinkage) * f
+        return self
+
     def scaled_copy(self, factor: float) -> "Tree":
         """Deep copy with every leaf output scaled by ``factor`` —
         Tree::Shrinkage applied at merge time (GBDT.merge_from's
-        ``shrinkage_decay``).  ``internal_value`` and the recorded
-        ``shrinkage`` scale with the leaves so the text serialization
-        stays self-consistent; the original tree is never touched (the
+        ``shrinkage_decay``); the original tree is never touched (the
         donor model keeps predicting exactly what it did)."""
-        t = copy.deepcopy(self)
-        f = float(factor)
-        if f != 1.0:
-            t.leaf_value = np.asarray(t.leaf_value, np.float64) * f
-            t.internal_value = np.asarray(t.internal_value, np.float64) * f
-            t.shrinkage = float(t.shrinkage) * f
-        return t
+        return copy.deepcopy(self).scale_leaf_outputs(factor)
 
     # ------------------------------------------------------------------
     def to_string(self) -> str:
@@ -225,8 +270,20 @@ class Tree:
             f"internal_value={_fmt_arr(self.internal_value[:n])}",
             f"internal_count={_fmt_int_arr(self.internal_count[:n])}",
             f"shrinkage={_fmt(self.shrinkage)}",
-            "",
         ]
+        if self.has_linear():
+            # affine-leaf sections (docs/LINEAR_TREES.md).  Written ONLY
+            # when some coefficient is non-zero: absent sections parse
+            # as constant leaves, so old readers/files interop and a
+            # degenerate (all-fallback) linear run serializes
+            # byte-identically to linear_tree=false
+            nl, k = self.leaf_coeff.shape
+            lines += [
+                f"num_linear_features={k}",
+                f"leaf_feat={_fmt_int_arr(self.leaf_feat.ravel())}",
+                f"leaf_coeff={_fmt_arr(self.leaf_coeff.ravel())}",
+            ]
+        lines.append("")
         return "\n".join(lines) + "\n"
 
     @classmethod
@@ -335,6 +392,39 @@ class Tree:
             raise LightGBMError(
                 "Tree model string format error: negative "
                 "split_feature index — corrupt model file?")
+        # optional affine-leaf sections (absent => constant leaves;
+        # old model files never carry them)
+        if "num_linear_features" in kv or "leaf_coeff" in kv \
+                or "leaf_feat" in kv:
+            try:
+                k = int(kv.get("num_linear_features", ""))
+            except ValueError:
+                raise LightGBMError(
+                    "Tree model string format error: num_linear_features="
+                    f"{kv.get('num_linear_features')!r} is not an integer "
+                    "(linear sections present but header missing/corrupt?)")
+            if k < 0 or k > (1 << 16):
+                raise LightGBMError(
+                    "Tree model string format error: "
+                    f"num_linear_features={k} is out of range")
+            if k > 0:
+                for key in ("leaf_feat", "leaf_coeff"):
+                    if key not in kv:
+                        raise LightGBMError(
+                            "Tree model string format error: "
+                            f"num_linear_features={k} but section {key} "
+                            "is missing — file truncated mid-tree?")
+                feat = _values("leaf_feat", num_leaves * k,
+                               lambda x: int(float(x)), np.int32)
+                coeff = _values("leaf_coeff", num_leaves * k, float,
+                                np.float64)
+                if (feat < -1).any():
+                    raise LightGBMError(
+                        "Tree model string format error: section "
+                        "leaf_feat holds an index below -1 — corrupt "
+                        "model file?")
+                t.leaf_feat = feat.reshape(num_leaves, k)
+                t.leaf_coeff = coeff.reshape(num_leaves, k)
         return t
 
     def to_json(self) -> dict:
@@ -353,12 +443,19 @@ class Tree:
                     "right_child": node_json(int(self.right_child[index])),
                 }
             leaf = ~index
-            return {
+            out = {
                 "leaf_index": int(leaf),
                 "leaf_parent": int(self.leaf_parent[leaf]),
                 "leaf_value": float(self.leaf_value[leaf]),
                 "leaf_count": int(self.leaf_count[leaf]),
             }
+            if self.has_linear():
+                keep = self.leaf_feat[leaf] >= 0
+                out["leaf_features"] = [
+                    int(f) for f in self.leaf_feat[leaf][keep]]
+                out["leaf_coeff"] = [
+                    float(c) for c in self.leaf_coeff[leaf][keep]]
+            return out
         return {"num_leaves": int(self.num_leaves),
                 "shrinkage": float(self.shrinkage),
                 "tree_structure": node_json(0) if self.num_leaves > 1 else {

@@ -24,6 +24,9 @@ file path without importing the package (and its jax dependency).
 HOST_PHASES = frozenset({
     "Bin::bundle",        # EFB bundle planning over the mapper sample
                           # (io/bundling.py, docs/SPARSE.md)
+    "Bin::linear_fit",    # per-stage batched leaf ridge solve
+                          # (models/linear.py, docs/LINEAR_TREES.md;
+                          # the fused path folds it into GBDT::tree)
     "GBDT::iteration",    # whole boosting round (obs.span, always on)
     "GBDT::boosting",
     "GBDT::bagging",
@@ -64,6 +67,9 @@ DEVICE_PHASES = frozenset({
     # CompiledForest fused inference program (serve/forest.py)
     "bin_lookup",
     "forest_walk",
+    "linear_fit",         # per-leaf affine epilogue of a linear forest
+                          # (docs/LINEAR_TREES.md; also the training-side
+                          # batched solve in models/linear.py)
     "transform",
 })
 
@@ -73,6 +79,7 @@ DEVICE_PARENT = {
     "split": "GBDT::tree",
     "bin_lookup": "Predict::forest",
     "forest_walk": "Predict::forest",
+    "linear_fit": "Predict::forest",
     "transform": "Predict::forest",
 }
 

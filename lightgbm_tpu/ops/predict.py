@@ -117,6 +117,50 @@ def predict_binned_forest(split_feature, split_bin, is_cat_node, left_child,
     return out
 
 
+# ledgered one level up, exactly like predict_binned_forest (the
+# linear-forest callers wrap this in their own CountingJit programs)
+@functools.partial(jax.jit, static_argnames=("max_steps",))  # graftcheck: disable=jit-raw
+def predict_binned_forest_linear(split_feature, split_bin, is_cat_node,
+                                 left_child, right_child, leaf_value,
+                                 leaf_coeff, leaf_feat, bins, raw,
+                                 max_steps: int):
+    """Sum of PIECE-WISE LINEAR tree predictions (docs/LINEAR_TREES.md).
+
+    Like :func:`predict_binned_forest` plus the per-leaf dot-product
+    epilogue: each tree contributes
+    ``leaf_value[leaf] + sum_k leaf_coeff[leaf, k] * raw[leaf_feat[leaf, k]]``.
+
+    Extra args: ``leaf_coeff`` [T, L, K] f32, ``leaf_feat`` [T, L, K]
+    i32 rows into ``raw`` (-1 = unused pad slot), ``raw`` [F, N] f32 raw
+    feature values with NaN pre-imputed to 0.0.  A separate entry point
+    (rather than optional args) keeps the constant-leaf program's trace
+    — and its compile-ledger identity — untouched.
+    """
+    N = bins.shape[1]
+    rows = jnp.arange(N)[:, None]
+
+    def body(carry, tree):
+        acc, comp = carry
+        sf, sb, ic, lc, rc, lv, lcf, lft = tree
+        val, leaf = predict_binned_tree(sf, sb, ic, lc, rc, lv, bins,
+                                        max_steps)
+        f_row = lft[leaf]                              # [N, K]
+        vals = raw[jnp.maximum(f_row, 0), rows]
+        vals = jnp.where(f_row >= 0, vals, 0.0)
+        val = val + (lcf[leaf] * vals).sum(axis=1)
+        y = val - comp
+        t = acc + y
+        comp = (t - acc) - y
+        return (t, comp), None
+
+    init = (jnp.zeros(N, dtype=jnp.float32), jnp.zeros(N, dtype=jnp.float32))
+    (out, _), _ = jax.lax.scan(body, init,
+                               (split_feature, split_bin, is_cat_node,
+                                left_child, right_child, leaf_value,
+                                leaf_coeff, leaf_feat))
+    return out
+
+
 @instrumented_jit(program="predict_leaves",
                   static_argnames=("max_steps",))
 def predict_leaf_indices_forest(split_feature, split_bin, is_cat_node,

@@ -121,6 +121,25 @@ def stack_class_trees(trees, num_leaves: int, cuts_num, cuts_cat):
     return sf, sb, ic, lc, rc, lv
 
 
+def stack_class_linear(trees, num_leaves: int, linear_k: int):
+    """Stack one class's per-leaf affine tables into ``[T, L, Kf]``
+    coeff (f32) / feat (i32 REAL feature indices, -1 pad) arrays
+    (docs/LINEAR_TREES.md).  Constant trees contribute all-zero rows, so
+    the shared epilogue is a no-op for them."""
+    T = len(trees)
+    L = max(num_leaves, 2)
+    kf = max(linear_k, 1)
+    lcf = np.zeros((T, L, kf), np.float32)
+    lft = np.full((T, L, kf), -1, np.int32)
+    for t, tree in enumerate(trees):
+        if not tree.has_linear():
+            continue
+        nl, tk = tree.leaf_coeff.shape
+        lcf[t, :nl, :tk] = tree.leaf_coeff
+        lft[t, :nl, :tk] = tree.leaf_feat
+    return lcf, lft
+
+
 class CompiledForest:
     """Immutable inference artifact: stacked SoA forest + cut tables +
     shape-bucketed compiled programs.  Build with :meth:`from_booster`."""
@@ -155,12 +174,23 @@ class CompiledForest:
                           else "sigmoid" if self.sigmoid > 0 else "identity")
         self.ladder = BucketLadder(buckets)
 
+        # -- piece-wise linear forest? (docs/LINEAR_TREES.md)  Kept as a
+        # build-time property: constant forests keep the exact pre-linear
+        # program signatures (and compile-ledger identity).
+        self._has_linear = any(t.has_linear() for t in models)
+        self.linear_k = (max([t.leaf_feat.shape[1] for t in models
+                              if t.has_linear()] or [1])
+                         if self._has_linear else 0)
+
         # -- cut tables (host f64/int64 exact + device f32/int32 copies)
         self._cuts_num, self._cuts_cat = build_cut_tables(models)
         F = self.num_features
         for f in list(self._cuts_num) + list(self._cuts_cat):
             if f >= F:       # loaded model with max_feature_idx unset/low
                 F = self.num_features = f + 1
+        for t in models:     # affine covariates widen the matrix too
+            if t.has_linear() and int(t.leaf_feat.max(initial=-1)) >= F:
+                F = self.num_features = int(t.leaf_feat.max()) + 1
         self.max_cuts = max(
             [len(v) for v in self._cuts_num.values()]
             + [len(v) for v in self._cuts_cat.values()] + [1])
@@ -196,14 +226,34 @@ class CompiledForest:
         self._tree_dev = tuple(
             jnp.asarray(np.stack([s[i] for s in stacks], axis=0))
             for i in range(6))
+        self._lin_dev = None
+        if self._has_linear:
+            lin_stacks = []
+            for ts in per_class:
+                lcf, lft = stack_class_linear(ts, self.num_leaves,
+                                              self.linear_k)
+                if len(ts) < T:   # ragged tail: all-zero epilogue rows
+                    pad = T - len(ts)
+                    lcf = np.concatenate(
+                        [lcf, np.zeros((pad,) + lcf.shape[1:],
+                                       np.float32)], axis=0)
+                    lft = np.concatenate(
+                        [lft, np.full((pad,) + lft.shape[1:], -1,
+                                      np.int32)], axis=0)
+                lin_stacks.append((lcf, lft))
+            self._lin_dev = tuple(
+                jnp.asarray(np.stack([s[i] for s in lin_stacks], axis=0))
+                for i in range(2))
         # default placement (first local device); serve/fleet.py pins
         # per-replica copies with to_device()
         self.device = None
         obs.devprof.transfer(
             "h2d", "forest",
             int(bnd.nbytes) + int(cats.nbytes) + int(is_cat.nbytes)
-            + sum(int(a.nbytes) for a in self._tree_dev),
-            transfers=3 + len(self._tree_dev))
+            + sum(int(a.nbytes) for a in self._tree_dev)
+            + sum(int(a.nbytes) for a in (self._lin_dev or ())),
+            transfers=3 + len(self._tree_dev)
+            + len(self._lin_dev or ()))
         obs.inc("forest_compile_artifacts")
         obs.set_gauge("forest_trees", int(n_models))
         obs.set_gauge("forest_leaves_padded", int(self.num_leaves))
@@ -216,13 +266,28 @@ class CompiledForest:
 
     # ------------------------------------------------------------------
     # fused programs
-    def _walk(self, tree_dev, bins):
-        """Per-class Kahan forest sums on ``bins`` [F, B] -> [K, B]."""
+    def _walk(self, tree_dev, bins, lin_dev=None, xt=None):
+        """Per-class Kahan forest sums on ``bins`` [F, B] -> [K, B].
+
+        For a linear forest ``lin_dev`` carries the [K, T, L, Kf]
+        coeff/feat stacks and ``xt`` the [F, B] f32 raw covariates (NaN
+        pre-imputed to 0.0): the walk gains the per-leaf dot-product
+        epilogue (docs/LINEAR_TREES.md) via the separate linear entry
+        point, leaving constant forests' programs untouched."""
         import jax
         import jax.numpy as jnp
-        from ..ops.predict import predict_binned_forest
+        from ..ops.predict import (predict_binned_forest,
+                                   predict_binned_forest_linear)
 
         sf, sb, ic, lc, rc, lv = tree_dev
+        if lin_dev is not None:
+            lcf, lft = lin_dev
+            with jax.named_scope("linear_fit"):
+                outs = [predict_binned_forest_linear(
+                            sf[k], sb[k], ic[k], lc[k], rc[k], lv[k],
+                            lcf[k], lft[k], bins, xt, self.num_leaves)
+                        for k in range(self.num_class)]
+                return jnp.stack(outs, axis=0)
         with jax.named_scope("forest_walk"):
             outs = [predict_binned_forest(sf[k], sb[k], ic[k], lc[k],
                                           rc[k], lv[k], bins,
@@ -246,6 +311,17 @@ class CompiledForest:
         import jax
         import jax.numpy as jnp
 
+        if self._has_linear:
+            # linear forests carry the coeff/feat stacks plus the raw
+            # f32 covariates [F, B] (NaN pre-imputed on the host) into
+            # the program; constant forests keep the exact pre-linear
+            # signature below so their traced programs stay identical
+            def binned_lin_fn(tree_dev, bins, mask, lin_dev, xt):
+                raw = self._walk(tree_dev, bins, lin_dev, xt)
+                raw = jnp.where(mask[None, :], raw, 0.0)
+                return raw
+            return jax.jit(binned_lin_fn)  # graftcheck: disable=jit-raw
+
         def binned_fn(tree_dev, bins, mask):
             raw = self._walk(tree_dev, bins)
             raw = jnp.where(mask[None, :], raw, 0.0)
@@ -258,7 +334,9 @@ class CompiledForest:
         import jax
         import jax.numpy as jnp
 
-        def raw_fn(tree_dev, bnd, cats, is_cat, X, mask):
+        has_linear = self._has_linear
+
+        def raw_fn(tree_dev, bnd, cats, is_cat, X, mask, lin_dev=None):
             # raw floats [B, F] -> cut-table bins [F, B], on device
             with jax.named_scope("bin_lookup"):
                 Xt = X.T
@@ -276,7 +354,13 @@ class CompiledForest:
                 hit = jnp.take_along_axis(cats, jc, axis=1) == iv
                 cbin = jnp.where(hit & ~isnan, jc, -1)
                 bins = jnp.where(is_cat[:, None], cbin, nbin)
-            raw = self._walk(tree_dev, bins)
+            if has_linear:
+                # the NaN-imputed transpose already built for binning IS
+                # the affine covariate matrix [F, B] — no second feed
+                raw = self._walk(tree_dev, bins, lin_dev,
+                                 safe.astype(jnp.float32))
+            else:
+                raw = self._walk(tree_dev, bins)
             raw = jnp.where(mask[None, :], raw, 0.0)
             out = self._transform(raw)
             out = jnp.where(mask[None, :], out, 0.0)
@@ -343,7 +427,17 @@ class CompiledForest:
             obs.devprof.transfer("h2d", "serve",
                                  int(np.asarray(bins).nbytes))
             with timetag.scope("Predict::forest"):
-                raw = self._binned_jit(bucket, self._tree_dev, bins, mask)
+                if self._has_linear:
+                    # affine covariates: the same padded rows, NaN->0
+                    # f32, [F, B] (docs/LINEAR_TREES.md)
+                    xt = np.where(np.isnan(Xp), 0.0,
+                                  Xp).T.astype(np.float32)
+                    obs.devprof.transfer("h2d", "serve", int(xt.nbytes))
+                    raw = self._binned_jit(bucket, self._tree_dev, bins,
+                                           mask, self._lin_dev, xt)
+                else:
+                    raw = self._binned_jit(bucket, self._tree_dev, bins,
+                                           mask)
             obs.devprof.transfer("d2h", "serve", int(raw.nbytes))
             parts.append(np.asarray(raw, np.float64)[:, :n])
         return np.concatenate(parts, axis=1)
@@ -362,9 +456,15 @@ class CompiledForest:
             obs.devprof.transfer("h2d", "serve",
                                  int(Xp.nbytes) + int(mask.nbytes))
             with timetag.scope("Predict::forest"):
-                raw, out = self._raw_jit(bucket, self._tree_dev,
-                                         self._bnd_dev, self._cats_dev,
-                                         self._is_cat_dev, Xp, mask)
+                if self._has_linear:
+                    raw, out = self._raw_jit(bucket, self._tree_dev,
+                                             self._bnd_dev, self._cats_dev,
+                                             self._is_cat_dev, Xp, mask,
+                                             self._lin_dev)
+                else:
+                    raw, out = self._raw_jit(bucket, self._tree_dev,
+                                             self._bnd_dev, self._cats_dev,
+                                             self._is_cat_dev, Xp, mask)
             obs.devprof.transfer("d2h", "serve",
                                  int(raw.nbytes) + int(out.nbytes))
             raws.append(np.asarray(raw)[:, :n])
@@ -413,6 +513,9 @@ class CompiledForest:
         clone._bnd_dev = jax.device_put(self._bnd_dev, device)
         clone._cats_dev = jax.device_put(self._cats_dev, device)
         clone._is_cat_dev = jax.device_put(self._is_cat_dev, device)
+        if self._lin_dev is not None:
+            clone._lin_dev = tuple(jax.device_put(a, device)
+                                   for a in self._lin_dev)
         clone._binned_jit = CountingJit(clone._make_binned_fn(),
                                         "predict_forest")
         clone._raw_jit = CountingJit(clone._make_raw_fn(), "serve_forest")
@@ -420,8 +523,10 @@ class CompiledForest:
             "h2d", "forest",
             sum(int(a.nbytes) for a in clone._tree_dev)
             + int(clone._bnd_dev.nbytes) + int(clone._cats_dev.nbytes)
-            + int(clone._is_cat_dev.nbytes),
-            transfers=3 + len(clone._tree_dev))
+            + int(clone._is_cat_dev.nbytes)
+            + sum(int(a.nbytes) for a in (clone._lin_dev or ())),
+            transfers=3 + len(clone._tree_dev)
+            + len(clone._lin_dev or ()))
         return clone
 
     def warmup(self, buckets: Optional[Sequence[int]] = None,
@@ -436,10 +541,20 @@ class CompiledForest:
         for s in sizes:
             dummy = np.zeros((min(s, 2), self.num_features))
             Xp, mask = pad_rows(np.asarray(dummy, np.float64), s)
-            self._binned_jit(s, self._tree_dev, self.bin_rows(Xp), mask)
-            Xp32, mask = pad_rows(np.asarray(dummy, np.float32), s)
-            self._raw_jit(s, self._tree_dev, self._bnd_dev, self._cats_dev,
-                          self._is_cat_dev, Xp32, mask)
+            Xp32, mask32 = pad_rows(np.asarray(dummy, np.float32), s)
+            if self._has_linear:
+                xt = np.where(np.isnan(Xp), 0.0, Xp).T.astype(np.float32)
+                self._binned_jit(s, self._tree_dev, self.bin_rows(Xp),
+                                 mask, self._lin_dev, xt)
+                self._raw_jit(s, self._tree_dev, self._bnd_dev,
+                              self._cats_dev, self._is_cat_dev, Xp32,
+                              mask32, self._lin_dev)
+            else:
+                self._binned_jit(s, self._tree_dev, self.bin_rows(Xp),
+                                 mask)
+                self._raw_jit(s, self._tree_dev, self._bnd_dev,
+                              self._cats_dev, self._is_cat_dev, Xp32,
+                              mask32)
         obs.inc("forest_warmups")
         return self
 
@@ -452,6 +567,7 @@ class CompiledForest:
             "transform": self.transform,
             "buckets": list(self.ladder.sizes),
             "max_cuts": int(self.max_cuts),
+            "linear": bool(self._has_linear),
         }
         if self.device is not None:
             out["device"] = str(self.device)

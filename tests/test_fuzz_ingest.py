@@ -148,3 +148,45 @@ def test_tree_from_string_never_escapes_lightgbmerror():
         except Exception as exc:  # noqa: BLE001 - the contract
             pytest.fail(f"mutation {i}: {type(exc).__name__} escaped "
                         f"Tree.from_string: {exc!r}")
+
+
+def test_linear_tree_sections_never_escape_lightgbmerror():
+    """Affine-leaf model sections (docs/LINEAR_TREES.md): a structurally
+    valid linear tree text, then mutated — every outcome must be a
+    successful parse or a LightGBMError (truncated/garbled leaf_coeff /
+    leaf_feat / num_linear_features must all be NAMED refusals)."""
+    seed = (
+        "num_leaves=3\n"
+        "split_feature=1 0\n"
+        "split_gain=1.5 0.75\n"
+        "threshold=0.25 -1.5\n"
+        "decision_type=0 0\n"
+        "left_child=1 -1\n"
+        "right_child=-2 -3\n"
+        "leaf_parent=1 0 1\n"
+        "leaf_value=0.1 -0.2 0.3\n"
+        "leaf_count=10 20 30\n"
+        "internal_value=0.05 0.15\n"
+        "internal_count=60 30\n"
+        "shrinkage=0.1\n"
+        "num_linear_features=2\n"
+        "leaf_feat=1 0 -1 -1 0 1\n"
+        "leaf_coeff=0.5 -0.25 0 0 1.5 0.125\n").encode()
+    rng = np.random.RandomState(1234)
+    # mutation sweep biased at the linear tail: 20 whole-text mutations
+    # plus 20 mutations of ONLY the three linear lines (kept appended to
+    # the intact constant body, so the linear parser is what's exercised)
+    body, linear_tail = seed.split(b"num_linear_features=", 1)
+    linear_tail = b"num_linear_features=" + linear_tail
+    cases = [_mutate(seed, rng) for _ in range(20)]
+    cases += [body + _mutate(linear_tail, rng) for _ in range(20)]
+    for i, blob in enumerate(cases):
+        text = blob.decode("utf-8", errors="replace")
+        try:
+            t = Tree.from_string(text)
+            assert t.num_leaves >= 1
+        except LightGBMError:
+            pass
+        except Exception as exc:  # noqa: BLE001 - the contract
+            pytest.fail(f"linear mutation {i}: {type(exc).__name__} "
+                        f"escaped Tree.from_string: {exc!r}")

@@ -1,0 +1,375 @@
+"""Piece-wise linear trees (docs/LINEAR_TREES.md): affine leaves with
+batched on-device ridge solves, end to end from training to serving.
+
+Pins:
+
+- **fewer trees**: on a piece-wise linear synthetic, the linear booster
+  reaches the constant booster's best training l2 in <= half the trees;
+- **serving parity**: ``CompiledForest.predict == Booster.predict``
+  within 1e-6 across the bucket ladder, and save -> load ->
+  ``CompiledForest.from_booster`` round-trips exactly;
+- **identity**: ``linear_max_leaf_features=0`` produces a BYTE-identical
+  model to ``linear_tree=false``; ``linear_tree=false`` runs never
+  compile a linear program;
+- **compile ledger**: after warmup, linear rounds record ZERO new XLA
+  programs (the K-padded fit shares one program across trees/rounds);
+- **single scaling point**: merge + shrinkage_decay on a linear forest
+  predicts exactly ``base + d * delta`` (slopes scale with intercepts);
+- **fallbacks**: data-starved leaves fall back to constant values and
+  count into ``linear_fallback_total``;
+- **named refusals**: missing raw feature values, truncated model-text
+  coefficient sections.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import LightGBMError
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.models.tree import Tree
+from lightgbm_tpu.serve.forest import CompiledForest
+
+pytestmark = [pytest.mark.linear]
+
+
+def _piecewise(n=3000, f=8, seed=0):
+    """Piece-wise linear response whose slopes are on the SPLIT features
+    (leaf models fit over root-to-leaf path features, so slopes on
+    non-split features are invisible to them): affine leaves capture
+    each segment in one fit; constant leaves must staircase it."""
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-2.0, 2.0, size=(n, f))
+    y = (np.where(X[:, 1] > 0.0, 2.5 * X[:, 1], -1.0 * X[:, 1])
+         + np.where(X[:, 2] > 0.5, 1.5 * (X[:, 2] - 0.5), 0.0))
+    y = y + 0.05 * rng.normal(size=n)
+    return X, y
+
+
+def _params(linear=True, **over):
+    p = {"objective": "regression", "metric": "l2", "num_leaves": 15,
+         "learning_rate": 0.15, "min_data_in_leaf": 20, "verbose": -1,
+         "seed": 7}
+    if linear:
+        p.update({"linear_tree": True, "linear_lambda": 0.01,
+                  "linear_max_leaf_features": 4})
+    p.update(over)
+    return p
+
+
+def _train(X, y, rounds, linear=True, **over):
+    return lgb.train(_params(linear, **over), lgb.Dataset(X, label=y),
+                     num_boost_round=rounds)
+
+
+# ---------------------------------------------------------------------------
+# fewer-trees demo: the point of the subsystem
+# ---------------------------------------------------------------------------
+
+def test_linear_reaches_const_best_with_half_the_trees():
+    import jax
+    X, y = _piecewise()
+    const_rounds = 40
+    ds = BinnedDataset.from_matrix(X, y, max_bin=63, min_data_in_leaf=20,
+                                   keep_raw=True)
+
+    def l2_curve(linear, rounds):
+        # lr=0.5: per-round progress is bounded by lr * (tree fit
+        # quality); a damped lr hides the fit-quality gap until the
+        # constant staircase's approximation floor, so the fewer-trees
+        # effect shows at moderate-to-high learning rates
+        cfg = Config(_params(linear, num_iterations=rounds, max_bin=63,
+                             learning_rate=0.5))
+        gb = GBDT(cfg, ds)
+        curve = []
+        for _ in range(rounds):
+            gb.train_one_iter()
+            jax.block_until_ready(gb.train_data.score)
+            curve.append(float(gb.eval_metrics()["training"]["l2"]))
+        return curve
+
+    const_curve = l2_curve(False, const_rounds)
+    target = min(const_curve)
+    lin_curve = l2_curve(True, const_rounds // 2)
+    reached = next((i + 1 for i, v in enumerate(lin_curve)
+                    if v <= target), None)
+    assert reached is not None and reached <= const_rounds // 2, (
+        f"linear never reached the constant run's best l2 {target:.6f} "
+        f"within {const_rounds // 2} trees (best "
+        f"{min(lin_curve):.6f}) — the fewer-trees demo regressed")
+
+
+# ---------------------------------------------------------------------------
+# serving parity + round trips
+# ---------------------------------------------------------------------------
+
+def test_compiled_forest_parity_across_bucket_ladder():
+    X, y = _piecewise(n=700)
+    bst = _train(X, y, rounds=12)
+    ref = bst.predict(X, raw_score=True)
+    cf = CompiledForest.from_booster(bst, buckets=[16, 64, 256])
+    # sizes below / at / straddling bucket boundaries, incl. remainders
+    for n in (1, 15, 16, 17, 64, 200, 257, 700):
+        got = cf.predict(X[:n], raw_score=True)
+        assert np.abs(got - ref[:n]).max() <= 1e-6, (
+            f"linear forest parity broke at n={n}")
+    # transformed output goes through the same epilogue
+    assert np.abs(cf.predict(X[:100]) - bst.predict(X[:100])).max() <= 1e-6
+
+
+def test_booster_predict_routes_linear_through_compiled_forest():
+    # >=4096 rows auto-freezes a CompiledForest: the fast path must
+    # carry the affine stacks (this is exactly the path that scored
+    # wrong before serving support landed)
+    X, y = _piecewise(n=5000)
+    bst = _train(X, y, rounds=10)
+    big = bst.predict(X, raw_score=True)
+    small = np.concatenate([bst.predict(X[i:i + 500], raw_score=True)
+                            for i in range(0, len(X), 500)])
+    assert np.abs(big - small).max() <= 1e-6
+
+
+def test_save_load_compiled_forest_round_trip(tmp_path):
+    X, y = _piecewise(n=600)
+    bst = _train(X, y, rounds=8)
+    assert any(t.has_linear()
+               for t in bst._booster.models), "no affine leaf fit"
+    path = tmp_path / "linear.txt"
+    bst.save_model(str(path))
+    loaded = lgb.Booster(model_file=str(path))
+    assert loaded.model_to_string() == bst.model_to_string()
+    ref = CompiledForest.from_booster(bst).predict(X, raw_score=True)
+    got = CompiledForest.from_booster(loaded).predict(X, raw_score=True)
+    assert np.array_equal(ref, got)
+    assert CompiledForest.from_booster(loaded).info()["linear"] is True
+
+
+def test_old_model_files_without_linear_sections_load(tmp_path):
+    X, y = _piecewise(n=400)
+    bst = _train(X, y, rounds=4, linear=False)
+    text = bst.model_to_string()
+    assert "leaf_coeff" not in text          # constant models stay clean
+    path = tmp_path / "const.txt"
+    bst.save_model(str(path))
+    loaded = lgb.Booster(model_file=str(path))
+    assert not any(t.has_linear() for t in loaded._booster.models)
+    assert np.array_equal(loaded.predict(X[:100]), bst.predict(X[:100]))
+
+
+# ---------------------------------------------------------------------------
+# identity pins: off means OFF
+# ---------------------------------------------------------------------------
+
+def test_k0_is_byte_identical_to_linear_tree_false():
+    X, y = _piecewise(n=500)
+    off = _train(X, y, rounds=6, linear=False)
+    k0 = _train(X, y, rounds=6, linear=True, linear_max_leaf_features=0)
+    assert k0.model_to_string() == off.model_to_string()
+
+
+def test_linear_off_never_compiles_a_linear_program():
+    from lightgbm_tpu.obs import compile_ledger
+    n0 = len(compile_ledger.events())
+    X, y = _piecewise(n=500)
+    _train(X, y, rounds=4, linear=False)
+    for ev in compile_ledger.events()[n0:]:
+        assert ev["program"] != "linear_fit", (
+            "a linear_tree=false run compiled the linear-fit program")
+
+
+# ---------------------------------------------------------------------------
+# compile-ledger flatness: the K-padding contract
+# ---------------------------------------------------------------------------
+
+def test_linear_rounds_compile_nothing_after_warmup():
+    import jax
+    from lightgbm_tpu.obs import compile_ledger
+    X, y = _piecewise(n=800)
+    ds = BinnedDataset.from_matrix(X, y, max_bin=63, min_data_in_leaf=20,
+                                   keep_raw=True)
+    cfg = Config(_params(num_iterations=10, max_bin=63))
+    gb = GBDT(cfg, ds)
+    for _ in range(3):                       # warmup: compile everything
+        gb.train_one_iter()
+    jax.block_until_ready(gb.train_data.score)
+    gb._flush_pending()                      # drain the pipeline
+    n0 = len(compile_ledger.events())
+    for _ in range(5):
+        gb.train_one_iter()
+    jax.block_until_ready(gb.train_data.score)
+    gb._flush_pending()
+    new = compile_ledger.events()[n0:]
+    assert not new, (
+        "steady-state linear rounds recompiled: "
+        + ", ".join(f"{e['program']}({e['shapes']})" for e in new))
+
+
+# ---------------------------------------------------------------------------
+# single scaling point: merge / shrinkage / negation
+# ---------------------------------------------------------------------------
+
+def test_merge_with_shrinkage_scales_slopes_with_intercepts():
+    X, y = _piecewise(n=600)
+    base = _train(X, y, rounds=4)
+    delta = _train(X, y, rounds=3, learning_rate=0.3)
+    pb = base.predict(X, raw_score=True)
+    pd = delta.predict(X, raw_score=True)
+    merged = base.merge(delta, shrinkage_decay=0.5)
+    pm = merged.predict(X, raw_score=True)
+    assert np.abs(pm - (pb + 0.5 * pd)).max() <= 1e-6, (
+        "merge+shrinkage on a linear forest drifted — leaf_coeff is "
+        "not scaling through Tree.scale_leaf_outputs")
+    # the merged model text still carries the (scaled) coefficients
+    assert "leaf_coeff" in merged.model_to_string()
+
+
+def test_scaled_copy_scales_coefficients_and_leaves():
+    text = (
+        "num_leaves=3\n"
+        "split_feature=1 0\n"
+        "split_gain=1.5 0.75\n"
+        "threshold=0.25 -1.5\n"
+        "decision_type=0 0\n"
+        "left_child=1 -1\n"
+        "right_child=-2 -3\n"
+        "leaf_parent=1 0 1\n"
+        "leaf_value=0.1 -0.2 0.3\n"
+        "leaf_count=10 20 30\n"
+        "internal_value=0.05 0.15\n"
+        "internal_count=60 30\n"
+        "shrinkage=0.1\n"
+        "num_linear_features=2\n"
+        "leaf_feat=1 0 -1 -1 0 1\n"
+        "leaf_coeff=0.5 -0.25 0 0 1.5 0.125\n")
+    t = Tree.from_string(text)
+    s = t.scaled_copy(0.5)
+    assert np.array_equal(s.leaf_value, t.leaf_value * 0.5)
+    assert np.array_equal(s.leaf_coeff, t.leaf_coeff * 0.5)
+    assert np.array_equal(s.leaf_feat, t.leaf_feat)      # indices fixed
+    assert np.array_equal(t.leaf_coeff[0], [0.5, -0.25])  # original kept
+    # factors multiply exactly through repeated scaling (DART, merge)
+    d = t.scaled_copy(0.5).scale_leaf_outputs(2.0)
+    X = np.random.RandomState(3).normal(size=(50, 3))
+    assert np.allclose(d.predict(X), t.predict(X), atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# fallbacks
+# ---------------------------------------------------------------------------
+
+def test_data_starved_leaves_fall_back_and_count():
+    from lightgbm_tpu import obs
+    before = obs.get_counter("linear_fallback_total")
+    X, y = _piecewise(n=60)
+    # K=16 needs >= 18 rows per leaf; these leaves hold 10-15
+    over = dict(num_leaves=31, min_data_in_leaf=2,
+                linear_max_leaf_features=16)
+    bst = _train(X, y, rounds=5, **over)
+    assert obs.get_counter("linear_fallback_total") > before
+    # every leaf fell back, so no tree kept a model and the run is
+    # byte-identical to linear_tree=false (the all-fallback identity)
+    assert not any(t.has_linear() for t in bst._booster.models)
+    const = _train(X, y, rounds=5, linear=False, **{
+        k: v for k, v in over.items() if k != "linear_max_leaf_features"})
+    assert bst.model_to_string() == const.model_to_string()
+
+
+# ---------------------------------------------------------------------------
+# named refusals
+# ---------------------------------------------------------------------------
+
+def test_linear_without_raw_values_is_refused():
+    X, y = _piecewise(n=300)
+    ds = BinnedDataset.from_matrix(X, y, max_bin=63, min_data_in_leaf=20)
+    assert ds.raw is None
+    with pytest.raises(LightGBMError, match="raw feature values"):
+        GBDT(Config(_params(num_iterations=2, max_bin=63)), ds)
+
+
+def test_valid_set_without_raw_values_is_refused():
+    X, y = _piecewise(n=300)
+    ds = BinnedDataset.from_matrix(X, y, max_bin=63, min_data_in_leaf=20,
+                                   keep_raw=True)
+    gb = GBDT(Config(_params(num_iterations=2, max_bin=63)), ds)
+    Xv, yv = _piecewise(n=100, seed=1)
+    dv = ds.create_valid(Xv, yv)
+    dv.raw = None         # e.g. restored from a raw-less binary snapshot
+    with pytest.raises(LightGBMError, match="raw feature values"):
+        gb.add_valid_dataset(dv)
+
+
+def test_truncated_coefficient_section_is_a_named_error():
+    text = (
+        "num_leaves=2\n"
+        "split_feature=0\n"
+        "split_gain=1.0\n"
+        "threshold=0.0\n"
+        "decision_type=0\n"
+        "left_child=-1\n"
+        "right_child=-2\n"
+        "leaf_parent=0 0\n"
+        "leaf_value=0.1 -0.2\n"
+        "leaf_count=10 20\n"
+        "internal_value=0.05\n"
+        "internal_count=30\n"
+        "shrinkage=0.1\n"
+        "num_linear_features=2\n"
+        "leaf_feat=1 0 -1 -1\n"
+        "leaf_coeff=0.5 -0.25 0\n")          # 3 of 4 values: truncated
+    with pytest.raises(LightGBMError, match="leaf_coeff"):
+        Tree.from_string(text)
+
+
+def test_bad_linear_feature_count_is_a_named_error():
+    text = (
+        "num_leaves=2\n"
+        "split_feature=0\n"
+        "split_gain=1.0\n"
+        "threshold=0.0\n"
+        "decision_type=0\n"
+        "left_child=-1\n"
+        "right_child=-2\n"
+        "leaf_parent=0 0\n"
+        "leaf_value=0.1 -0.2\n"
+        "leaf_count=10 20\n"
+        "internal_value=0.05\n"
+        "internal_count=30\n"
+        "shrinkage=0.1\n"
+        "num_linear_features=banana\n"
+        "leaf_feat=1 0\n"
+        "leaf_coeff=0.5 -0.25\n")
+    with pytest.raises(LightGBMError, match="num_linear_features"):
+        Tree.from_string(text)
+
+
+# ---------------------------------------------------------------------------
+# bench_regress passthrough (informational `linear` BENCH block)
+# ---------------------------------------------------------------------------
+
+def test_bench_regress_passes_linear_block_through(tmp_path, capsys):
+    import importlib.util
+    import json
+    import pathlib
+    spec = importlib.util.spec_from_file_location(
+        "bench_regress", pathlib.Path(__file__).resolve().parent.parent
+        / "tools" / "bench_regress.py")
+    bench_regress = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_regress)
+
+    base = {"metric": "m", "value": 10.0, "unit": "iters/sec"}
+    cand = {"metric": "m", "value": 10.2, "unit": "iters/sec",
+            "linear": {"trees_to_const_best": 17, "fallback_rate": 0.02,
+                       "fit_s_per_round_median": 0.01}}
+    b = tmp_path / "b.json"
+    c = tmp_path / "c.json"
+    b.write_text(json.dumps(base))
+    c.write_text(json.dumps(cand))
+    rc = bench_regress.main(["--baseline", str(b), "--candidate", str(c),
+                             "--threshold", "5"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    verdict = json.loads(out)
+    assert rc == 0 and verdict["ok"]
+    assert verdict["linear_candidate"]["trees_to_const_best"] == 17
+    assert "linear_baseline" not in verdict

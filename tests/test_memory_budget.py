@@ -25,7 +25,7 @@ def test_estimate_components_scale_with_problem():
     big_cache = estimate_train_memory(1000, 8, 1023, 256, 1)
     assert set(small) == {"bins_device", "packed_payload",
                          "scores_and_gradients", "score_double_buffer",
-                         "histogram_cache", "vmem_scratch",
+                         "histogram_cache", "vmem_scratch", "linear_fit",
                          "working", "total"}
     assert all(v >= 0 for v in small.values())
     assert big_rows["bins_device"] > small["bins_device"]
@@ -33,6 +33,20 @@ def test_estimate_components_scale_with_problem():
     # cache term is exactly L * F * 9 * B * 4 bytes
     assert big_cache["histogram_cache"] == 1023 * 8 * 9 * 256 * 4
     assert small["total"] == sum(v for k, v in small.items() if k != "total")
+
+
+def test_estimate_linear_component():
+    """linear_tree (docs/LINEAR_TREES.md §Memory): linear_k bills the
+    raw f32 copy, the phi gathers, and the [L, K+1, K+1] normal
+    equations; linear_k=0 (the default) is exactly the old estimate."""
+    base = estimate_train_memory(1000, 8, 31, 64, 1)
+    lin = estimate_train_memory(1000, 8, 31, 64, 1, linear_k=4)
+    assert base["linear_fit"] == 0
+    m = 5
+    assert lin["linear_fit"] == (1000 * 8 * 4 + 2 * 1000 * m * 4
+                                 + 3 * 31 * m * m * 4)
+    assert lin["total"] == base["total"] + lin["linear_fit"]
+    assert lin["total"] == sum(v for k, v in lin.items() if k != "total")
 
 
 def test_estimate_flags_zero_their_components():

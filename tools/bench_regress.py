@@ -249,7 +249,11 @@ def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
         # AUC ride along informationally so an A/B ctrlike comparison
         # (bundling/screening on vs off) shows its accuracy asterisk;
         # never gated, never required (old baselines keep comparing)
-        for key in ("efb", "screening"):
+        # PR 18: piece-wise linear trees bill (docs/LINEAR_TREES.md) —
+        # trees-to-target vs the constant run, per-round fit seconds,
+        # leaf-fit fallback rate.  Informational: accuracy trade-offs are
+        # workload-dependent, never gated, never required
+        for key in ("efb", "screening", "linear"):
             blk = obj.get(key)
             if isinstance(blk, dict) and blk:
                 verdict[f"{key}_{side}"] = blk
