@@ -344,6 +344,18 @@ def predict_main(concurrency: int = 0) -> None:
     forest.warmup()
     t_warm = time.time() - t0
 
+    # drift observatory riding the measured traffic: a threadless
+    # collector hangs off the forest so every timed batch is also drift
+    # accounting — the BENCH `drift` block reports the window PSI summary
+    # and the collector's own compute seconds (docs/OBSERVABILITY.md
+    # §Drift).  No fingerprint on the model = no block, nothing attached.
+    from lightgbm_tpu.obs.drift import DriftCollector
+    drift_col = None
+    if forest.data_fingerprint is not None:
+        drift_col = DriftCollector(forest.data_fingerprint, model="bench",
+                                   window_s=3600.0, start_thread=False)
+        forest._drift = drift_col
+
     X32 = X.astype(np.float32)
     batches = {}
     for size in sizes:
@@ -363,6 +375,25 @@ def predict_main(concurrency: int = 0) -> None:
             "p50_ms": round(float(np.percentile(lat, 50)), 3),
             "p99_ms": round(float(np.percentile(lat, 99)), 3),
         }
+    drift_block = None
+    if drift_col is not None:
+        forest._drift = None
+        win = drift_col.flush() or {}
+        st = drift_col.stats()
+        feats = win.get("features") or {}
+        max_psi = max((d["psi"] for d in feats.values()), default=None)
+        drift_block = {
+            "windows": int(st["windows"]),
+            "rows": int(st["rows"]),
+            "dropped": int(st["dropped"]),
+            "overhead_s": round(float(st["overhead_s"]), 6),
+            "max_psi": (round(float(max_psi), 6)
+                        if max_psi is not None else None),
+            "score_psi": (round(float(win["score_psi"]), 6)
+                          if win.get("score_psi") is not None else None),
+        }
+        drift_col.close()
+
     top = batches[str(max(sizes))]
     # availability bill over the fleet run (round 9, serve/health.py):
     # hedged retries / ejections / deadline sheds as counter deltas —
@@ -383,6 +414,8 @@ def predict_main(concurrency: int = 0) -> None:
         "warmup_s": round(t_warm, 3),
         "compile_events": compile_ledger.summary(5),
     }
+    if drift_block is not None:
+        result["drift"] = drift_block
     result["profile"], result["device"] = _profile_blocks()
     if fleet is not None:
         result["concurrency"] = concurrency

@@ -103,6 +103,23 @@ DESC = {
                             "rollback — a re-reloaded candidate inside "
                             "it is rolled back immediately; doubles per "
                             "consecutive rollback (0 = none)",
+    "drift": "task=serve: off | on — streaming drift collector over the "
+             "served rows vs the model's training-data fingerprint "
+             "(docs/OBSERVABILITY.md §Drift; off is one attribute read "
+             "on the predict path)",
+    "drift_window": "task=serve: collector window seconds — each window "
+                    "computes per-feature PSI/KL/L-inf and score PSI on "
+                    "a host thread; shorter windows detect faster but "
+                    "sample fewer rows",
+    "drift_top_k": "task=serve: offending features labeled per window "
+                   "in drift_psi{feature=} gauges and named in drift "
+                   "verdicts (the full set is always in /stats)",
+    "lifecycle_drift_threshold": "task=serve: per-feature PSI above this "
+                                 "for consecutive canary windows votes "
+                                 "rollback with reason 'drift'; also the "
+                                 "train_delta skew-warning bar "
+                                 "(0 disables the gate; 0.25 = classic "
+                                 "major-shift reading)",
     "serve_max_body_bytes": "task=serve: request body size cap — larger "
                             "payloads are shed with 413 before any "
                             "parsing or device time (0 = no cap)",

@@ -312,6 +312,19 @@ _DEFAULTS: Dict[str, Any] = {
                                # (zero row_weight, bit-identical trees) so
                                # train_step/grow_tree programs are shared
                                # across nearby dataset sizes
+    # drift observatory (obs/drift.py; docs/OBSERVABILITY.md §Drift)
+    "drift": "off",             # serve-side drift collector: off | on
+                                # (needs a model with a data_fingerprint
+                                # section)
+    "drift_window": 30.0,       # collector window seconds (PSI/KL/L-inf
+                                # vs the fingerprint, computed per window
+                                # on a host thread)
+    "drift_top_k": 5,           # offending features labeled per window
+                                # in drift_psi{feature=} / /stats
+    "lifecycle_drift_threshold": 0.25,  # sustained per-feature PSI above
+                                        # this votes rollback (0 = gate
+                                        # off; 0.25 = classic major-shift
+                                        # reading)
 }
 
 _BOOL_KEYS = {k for k, v in _DEFAULTS.items() if isinstance(v, bool)}
@@ -579,6 +592,17 @@ class Config:
         if not (0.0 < v["shrinkage_decay"] <= 1.0):
             raise ValueError("shrinkage_decay must be in (0, 1] — 0 would "
                              "merge dead trees, > 1 would amplify them")
+        if v["drift"] not in ("off", "on"):
+            raise ValueError(f"drift must be 'off' or 'on', "
+                             f"got {v['drift']!r}")
+        if v["drift_window"] <= 0:
+            raise ValueError("drift_window must be > 0 seconds (disable "
+                             "the collector with drift=off instead)")
+        if v["drift_top_k"] < 1:
+            raise ValueError("drift_top_k must be >= 1")
+        if v["lifecycle_drift_threshold"] < 0:
+            raise ValueError("lifecycle_drift_threshold must be >= 0 "
+                             "(0 disables the drift gate)")
         # devprof mode grammar is owned by obs/devprof.parse_mode — a
         # typo'd value must die here, not silently disable profiling
         from .obs.devprof import parse_mode as _devprof_parse

@@ -16,7 +16,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from . import callback
+from . import callback, obs
 from .basic import Booster, Dataset
 from .utils import log
 from .utils.log import LightGBMError
@@ -318,6 +318,24 @@ def train(params, train_set, num_boost_round=100,
     return booster
 
 
+def _base_fingerprint(base_model):
+    """The base model's training-data fingerprint (obs/drift.py), from a
+    live Booster/engine or parsed straight out of a model-file tail.
+    None when the artifact predates fingerprints — the skew check then
+    quietly abstains."""
+    from .obs.drift import parse_model_fingerprint
+    try:
+        if isinstance(base_model, str):
+            with open(base_model) as fh:
+                return parse_model_fingerprint(fh.read())
+        inner = getattr(base_model, "_booster", base_model)
+        return getattr(inner, "data_fingerprint", None)
+    except Exception:
+        # a garbled section raises its NAMED error on the train() load
+        # path; the advisory check never preempts that diagnosis
+        return None
+
+
 def train_delta(base_model, fresh_data, num_trees=100, params=None,
                 **kwargs):
     """Warm-start retrain for the serve→retrain loop (docs/SERVING.md
@@ -326,10 +344,46 @@ def train_delta(base_model, fresh_data, num_trees=100, params=None,
     ``init_model`` path.  The base trees are carried over untouched —
     the returned booster's first ``base.num_trees()`` trees bit-match
     the base model — so the delta can be evaluated, merged
-    (``Booster.merge``), or served as a canary candidate on its own."""
-    return train(dict(params or {}), fresh_data,
-                 num_boost_round=num_trees, init_model=base_model,
-                 **kwargs)
+    (``Booster.merge``), or served as a canary candidate on its own.
+
+    Train/serve skew check (docs/OBSERVABILITY.md §Drift): the fresh
+    data's RAW rows are rebinned under the base artifact's fingerprint
+    edges — the same comparison the serve collector makes (two
+    fingerprints each bin their own data under their own quantile
+    ladders, so shifted data re-binned by its own quantiles looks
+    uniform again; data-vs-fingerprint is not fooled).  Drifted
+    features become a named WARNING (plus the
+    ``drift_skew_warnings_total`` counter), never a refusal: retraining
+    on shifted data is the point of the delta loop, but it should say
+    which columns moved."""
+    base_fp = _base_fingerprint(base_model)  # before the data swap below
+    raw = getattr(fresh_data, "data", None)  # before free_raw_data drops it
+    raw = None if isinstance(raw, str) else raw
+    booster = train(dict(params or {}), fresh_data,
+                    num_boost_round=num_trees, init_model=base_model,
+                    **kwargs)
+    cmp = None
+    threshold = float((params or {}).get("lifecycle_drift_threshold",
+                                         0.25) or 0.25)
+    top_k = int((params or {}).get("drift_top_k", 5) or 5)
+    if base_fp is not None and raw is not None:
+        from .obs.drift import compare_to_data
+        try:
+            cmp = compare_to_data(base_fp, raw, top_k=top_k)
+        except Exception:
+            cmp = None  # ragged/exotic raw payloads abstain, never fail
+    if cmp is not None:
+        offenders = [f for f in cmp["features"] if f["psi"] > threshold]
+        if offenders:
+            obs.inc("drift_skew_warnings_total")
+            log.warning(
+                "train_delta: fresh data drifted from the base model's "
+                "training distribution (train/serve skew): %s "
+                "(PSI threshold %g; rows %d -> %d)",
+                ", ".join(f"{f['feature']} psi={f['psi']:g}"
+                          for f in offenders),
+                threshold, cmp["expected_rows"], cmp["actual_rows"])
+    return booster
 
 
 class CVBooster:

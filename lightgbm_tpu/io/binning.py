@@ -58,6 +58,10 @@ class BinMapper:
         self.min_val: float = 0.0
         self.max_val: float = 0.0
         self.default_bin: int = 0
+        # FindBin sample occupancy per bin, retained for the drift
+        # fingerprint (obs/drift.py); None for mappers restored from
+        # pre-drift binary caches
+        self.bin_counts: Optional[np.ndarray] = None
 
     # ------------------------------------------------------------------
     def find_bin(self, sample_values: np.ndarray, total_sample_cnt: int,
@@ -211,6 +215,7 @@ class BinMapper:
         if not self.is_trivial:
             self.default_bin = int(self.value_to_bin(0.0))
         self.sparse_rate = cnt_in_bin[self.default_bin] / max(1, total_sample_cnt)
+        self.bin_counts = np.asarray(cnt_in_bin[: self.num_bin], np.int64)
         return self
 
     # ------------------------------------------------------------------
@@ -268,6 +273,8 @@ class BinMapper:
             "min_val": self.min_val,
             "max_val": self.max_val,
             "default_bin": self.default_bin,
+            "bin_counts": (self.bin_counts.tolist()
+                           if self.bin_counts is not None else None),
         }
 
     @classmethod
@@ -283,4 +290,7 @@ class BinMapper:
         m.min_val = float(state["min_val"])
         m.max_val = float(state["max_val"])
         m.default_bin = int(state["default_bin"])
+        # absent in pre-drift caches: fingerprinting quietly abstains
+        bc = state.get("bin_counts")
+        m.bin_counts = np.asarray(bc, np.int64) if bc is not None else None
         return m

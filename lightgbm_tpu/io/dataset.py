@@ -189,6 +189,10 @@ class BinnedDataset:
         # never materialize the full matrix, so they leave this None and
         # linear training refuses with a named error.
         self.raw: Optional[np.ndarray] = None
+        # drift fingerprint (obs/drift.py) — built by from_matrix only;
+        # streamed/subset/binary-cache paths leave it None and the drift
+        # observatory quietly abstains
+        self.data_fingerprint = None
 
     # -- construction ---------------------------------------------------
     @classmethod
@@ -286,6 +290,17 @@ class BinnedDataset:
             self.metadata.set_label(label)
         else:
             self.metadata.set_label(np.zeros(num_data, dtype=np.float32))
+
+        # drift fingerprint (obs/drift.py, docs/OBSERVABILITY.md §Drift):
+        # bin occupancy straight from the FindBin sample the mappers just
+        # retained, missing rates exact over the full matrix.  Cheap host
+        # bookkeeping at bin time; serialized with the model artifact.
+        if used:
+            from ..obs.drift import DataFingerprint
+            self.data_fingerprint = DataFingerprint.from_training(
+                mappers, used, self.feature_names, data,
+                np.asarray(label, np.float64) if label is not None
+                else None)
         return self
 
     def create_valid(self, data: np.ndarray, label=None) -> "BinnedDataset":

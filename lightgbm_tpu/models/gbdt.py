@@ -547,6 +547,10 @@ class GBDT:
     _degrade_steps: Tuple[str, ...] = ()   # applied steps, in order
     _degrade_force_donate = False  # score_donation step fired
     _degrade_leaf_cache_off = False  # hist_cache step fired
+    # -- drift observatory (obs/drift.py, docs/OBSERVABILITY.md §Drift):
+    # training-data fingerprint carried in the model artifact.  Distinct
+    # from snapshot_state's config "fingerprint" (resume compatibility).
+    data_fingerprint = None
 
     def __init__(self, config: Config, train_set: Optional[BinnedDataset],
                  objective: Optional[ObjectiveFunction] = None):
@@ -566,6 +570,7 @@ class GBDT:
     def _setup(self, train_set: BinnedDataset, objective) -> None:
         cfg = self.config
         self.train_set = train_set
+        self.data_fingerprint = getattr(train_set, "data_fingerprint", None)
         self.objective = objective or create_objective(cfg)
         self.objective.init(train_set.metadata, train_set.num_data)
         self.num_class = self.objective.num_tree_per_iteration
@@ -1127,6 +1132,12 @@ class GBDT:
                       "has different bin mappers")
         cfg = self.config
         self.train_set = train_set
+        # the fingerprint follows the data: a delta-trained model ships
+        # the FRESH data's fingerprint (train_delta compares it against
+        # the base model's before the swap)
+        new_fp = getattr(train_set, "data_fingerprint", None)
+        if new_fp is not None:
+            self.data_fingerprint = new_fp
         self.num_data = train_set.num_data
         self.objective.init(train_set.metadata, train_set.num_data)
         self.num_bin = jnp.asarray(train_set.num_bin_per_feature())
@@ -2428,6 +2439,18 @@ class GBDT:
         buf.write("\nfeature importances:\n")
         for name, cnt in self.feature_importance():
             buf.write(f"{name}={cnt}\n")
+        # optional drift fingerprint section (obs/drift.py) AFTER the
+        # footer: old readers ignore the tail, absent section = no
+        # fingerprint — the PR 18 linear-section back-compat pattern
+        fp = getattr(self, "data_fingerprint", None)
+        if fp is not None:
+            if fp.score_hist is None and getattr(self, "train_data",
+                                                 None) is not None:
+                # raw-margin training-score histogram, filled lazily at
+                # first save (serve compares raw scores — no transform
+                # disagreement between objectives)
+                fp.set_score_hist(self.train_data.host_score(np.float64))
+            buf.write("\n" + fp.to_text())
         return buf.getvalue()
 
     def save_model_to_file(self, path: str, num_iteration: int = -1) -> None:
@@ -2537,6 +2560,15 @@ class GBDT:
         if not hasattr(self, "objective") or self.objective is None:
             self.objective = _objective_for_prediction(
                 self.objective_name, self.sigmoid, self.num_class)
+        # optional drift fingerprint after the footer (obs/drift.py):
+        # absent -> None, truncated/garbled -> named LightGBMError with
+        # the model-file framing the rest of this loader uses
+        from ..obs.drift import DataFingerprint
+        try:
+            self.data_fingerprint = DataFingerprint.parse(
+                text[footer_pos:])
+        except LightGBMError as exc:
+            log.fatal("%s", exc)
 
     def num_trees(self) -> int:
         return len(self.models)

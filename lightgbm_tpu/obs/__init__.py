@@ -60,7 +60,7 @@ one end-to-end number.  Pieces:
   (``trace_events_file``/``LIGHTGBM_TPU_TRACE_EVENTS``).
 """
 
-from . import devcaps, devprof  # noqa: F401
+from . import devcaps, devprof, drift  # noqa: F401
 from .compile_ledger import (InstrumentedJit, abstract_shapes,  # noqa: F401
                              instrumented_jit)
 from .events import SCHEMA_VERSION, EventRecorder, read_events  # noqa: F401
@@ -112,5 +112,5 @@ __all__ = [
     "instrumented_jit", "InstrumentedJit", "abstract_shapes",
     "TRACER", "trace_span", "trace_begin", "trace_end", "trace_link",
     "HOST_PHASES", "DEVICE_PHASES", "DEVICE_PARENT", "JITTED_HOST_PHASES",
-    "TRANSFER_PHASES", "devprof", "devcaps",
+    "TRANSFER_PHASES", "devprof", "devcaps", "drift",
 ]

@@ -35,7 +35,13 @@ Two sibling inputs ride the same CLI (docs/OBSERVABILITY.md):
   prints the devprof decomposition: per-round host/device split, top-k
   programs by estimated device seconds with roofline %, H2D/D2H bytes
   per phase, forced-sync cost (docs/OBSERVABILITY.md §Device-time
-  attribution).
+  attribution);
+- ``--drift`` prints the drift observatory's per-model offender table
+  (PSI / missing-rate delta per feature, score PSI, window trajectory,
+  sustained offenders).  Positional files may be registry-snapshot JSON
+  (``obs.snapshot()`` dumps — latest published gauges) or drift-stats
+  JSON (the ``/stats`` ``drift`` block, which carries the trajectory);
+  none = the live process registry (docs/OBSERVABILITY.md §Drift).
 """
 
 from __future__ import annotations
@@ -288,6 +294,154 @@ def profile_summary_from_files(paths: Sequence[str],
     return profile_summary(r.snapshot(), top_k=top_k)
 
 
+def drift_summary(snap: Optional[Dict[str, Any]] = None,
+                  top_k: int = 5) -> Dict[str, Any]:
+    """The drift observatory's published account as one JSON-ready dict,
+    computed from a registry snapshot (default: the live process
+    registry).  Only the LAST window's gauges live in the registry; the
+    per-window trajectory needs a drift-stats file (``/stats`` drift
+    block) — ``drift_summary_from_files`` accepts either."""
+    from . import registry as _registry
+    from .prom import split_series
+    if snap is None:
+        snap = _registry.REGISTRY.snapshot()
+    g = dict(snap.get("gauges", {}))
+    c = dict(snap.get("counters", {}))
+    models: Dict[str, Dict[str, Any]] = {}
+
+    def _m(model: str) -> Dict[str, Any]:
+        return models.setdefault(model, {
+            "windows": 0, "rows": 0, "dropped": 0, "overhead_s": 0.0,
+            "score_psi": None, "features": {}})
+
+    for k, v in g.items():
+        base, labels = split_series(k)
+        if not base.startswith("drift_"):
+            continue
+        model = labels.get("model", "primary")
+        feat = labels.get("feature")
+        if base == "drift_psi" and feat is not None:
+            _m(model)["features"].setdefault(feat, {})["psi"] = float(v)
+        elif base == "drift_missing_delta" and feat is not None:
+            _m(model)["features"].setdefault(
+                feat, {})["missing_delta"] = float(v)
+        elif base == "drift_score_psi":
+            _m(model)["score_psi"] = float(v)
+        elif base == "drift_overhead_seconds":
+            _m(model)["overhead_s"] = round(float(v), 6)
+        elif base == "drift_rows_dropped_total":
+            _m(model)["dropped"] = int(v)
+    for k, v in c.items():
+        base, labels = split_series(k)
+        model = labels.get("model", "primary")
+        if base == "drift_windows_total":
+            _m(model)["windows"] = int(v)
+        elif base == "drift_rows_total":
+            _m(model)["rows"] = int(v)
+
+    for m in models.values():
+        feats = sorted(m.pop("features").items(),
+                       key=lambda t: -(t[1].get("psi") or 0.0))
+        m["offenders"] = [
+            {"feature": f, "psi": d.get("psi"),
+             "missing_delta": d.get("missing_delta")}
+            for f, d in feats[: max(int(top_k), 0)]]
+    return {"models": models}
+
+
+def drift_summary_from_files(paths: Sequence[str],
+                             top_k: int = 5) -> Dict[str, Any]:
+    """``--drift`` over files: registry-snapshot JSON files fold through
+    a fresh Registry (last published gauges); drift-stats JSON files
+    (the ``/stats`` ``drift`` block, or one collector's ``stats()``
+    dict) carry the window trajectory and sustained offenders and
+    overlay per model.  No files = the live registry."""
+    if not paths:
+        return drift_summary(top_k=top_k)
+    from .registry import Registry
+    r = Registry()
+    any_snap = False
+    live: Dict[str, Dict[str, Any]] = {}
+
+    def _take_stats(model: str, st: Dict[str, Any]) -> None:
+        live[str(model)] = st
+
+    for p in paths:
+        with open(p) as fh:
+            obj = json.load(fh)
+        if not isinstance(obj, dict):
+            raise ValueError(f"{p}: expected a JSON object")
+        if "counters" in obj or "gauges" in obj:
+            r.merge(obj)
+            any_snap = True
+        elif "window_s" in obj:                 # one collector's stats()
+            _take_stats(obj.get("model", "primary"), obj)
+        else:                                   # a /stats drift block
+            for model, st in obj.items():
+                if isinstance(st, dict) and "window_s" in st:
+                    _take_stats(model, st)
+
+    rep = (drift_summary(r.snapshot(), top_k=top_k)
+           if any_snap else {"models": {}})
+    for model, st in live.items():
+        m = rep["models"].setdefault(model, {})
+        last = st.get("last") or {}
+        m.update({
+            "windows": int(st.get("windows", 0)),
+            "rows": int(st.get("rows", 0)),
+            "dropped": int(st.get("dropped", 0)),
+            "overhead_s": round(float(st.get("overhead_s", 0.0)), 6),
+            "score_psi": last.get("score_psi"),
+            "offenders": list(last.get("top") or [])[: max(int(top_k), 0)],
+            "trajectory": list(st.get("trajectory") or []),
+            "sustained": st.get("sustained"),
+        })
+    return rep
+
+
+def render_drift_table(rep: Dict[str, Any]) -> str:
+    """Human-readable ``--drift`` offender table."""
+    out: List[str] = []
+    out.append("== obs-report (drift) ==")
+    if not rep["models"]:
+        out.append("(no drift series — serve with drift=on, or point at "
+                   "a registry snapshot / /stats drift block)")
+    for model in sorted(rep["models"]):
+        m = rep["models"][model]
+        out.append(f"-- model {model}: {m.get('windows', 0)} windows, "
+                   f"{m.get('rows', 0)} rows "
+                   f"({m.get('dropped', 0)} dropped), collector "
+                   f"{m.get('overhead_s', 0.0):.4f}s --")
+        sp = m.get("score_psi")
+        if sp is not None:
+            out.append(f"  score PSI {sp:.4f}")
+        for off in m.get("offenders") or []:
+            parts = [f"  {off.get('feature', '?'):<28}"]
+            for key in ("psi", "kl", "linf", "missing_delta"):
+                v = off.get(key)
+                if v is not None:
+                    parts.append(f"{key} {v:.4f}")
+            out.append("  ".join(parts))
+        sus = m.get("sustained") or {}
+        if sus.get("offenders"):
+            out.append(f"  sustained (psi > {sus.get('threshold')} for "
+                       f">= {sus.get('consecutive')} windows): "
+                       + ", ".join(sus["offenders"]))
+        traj = m.get("trajectory") or []
+        if traj:
+            out.append(f"  -- trajectory ({len(traj)} windows) --")
+            for w in traj:
+                top = ", ".join(w.get("top") or [])
+                mp = w.get("max_psi")
+                spw = w.get("score_psi")
+                out.append(
+                    f"    rows {w.get('rows', 0):>7}"
+                    + (f"  max_psi {mp:.4f}" if mp is not None else "")
+                    + (f"  score_psi {spw:.4f}" if spw is not None else "")
+                    + (f"  top [{top}]" if top else ""))
+    return "\n".join(out)
+
+
 def _fmt_bytes(n: int) -> str:
     v = float(n)
     for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
@@ -452,14 +606,16 @@ def render_profile_table(rep: Dict[str, Any]) -> str:
 def main(argv: Optional[Sequence[str]] = None) -> int:
     """CLI entry: ``python -m lightgbm_tpu obs-report <events.jsonl ...>
     [--format=json|table] [--top=K] [--compile=<ledger.jsonl>]``,
-    ``obs-report --traces <trace.json ...>``, or
-    ``obs-report --profile [<registry_snapshot.json ...>]``."""
+    ``obs-report --traces <trace.json ...>``,
+    ``obs-report --profile [<registry_snapshot.json ...>]``, or
+    ``obs-report --drift [<snapshot_or_drift_stats.json ...>]``."""
     argv = list(sys.argv[1:] if argv is None else argv)
     fmt = "table"
     top_k = 5
     compile_path: Optional[str] = None
     traces_mode = False
     profile_mode = False
+    drift_mode = False
     paths: List[str] = []
     for tok in argv:
         if tok.startswith("--format="):
@@ -477,12 +633,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             traces_mode = True
         elif tok == "--profile":
             profile_mode = True
+        elif tok == "--drift":
+            drift_mode = True
         elif tok.startswith("-"):
             print(f"obs-report: unknown flag {tok!r}", file=sys.stderr)
             return 2
         else:
             paths.append(tok)
-    if not paths and not profile_mode:
+    if not paths and not profile_mode and not drift_mode:
         print("usage: python -m lightgbm_tpu obs-report <events.jsonl ...> "
               "[--format=json|table] [--top=K] "
               "[--compile=<compile_ledger.jsonl>]\n"
@@ -490,7 +648,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               "<trace_events.json ...> [--format=json|table] [--top=K]\n"
               "       python -m lightgbm_tpu obs-report --profile "
               "[<registry_snapshot.json ...>] [--format=json|table] "
-              "[--top=K]",
+              "[--top=K]\n"
+              "       python -m lightgbm_tpu obs-report --drift "
+              "[<snapshot_or_drift_stats.json ...>] "
+              "[--format=json|table] [--top=K]",
               file=sys.stderr)
         return 2
     if fmt not in ("json", "table"):
@@ -498,7 +659,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               file=sys.stderr)
         return 2
     try:
-        if profile_mode:
+        if drift_mode:
+            rep = drift_summary_from_files(paths, top_k=top_k)
+        elif profile_mode:
             rep = profile_summary_from_files(paths, top_k=top_k)
         elif traces_mode:
             from .tracing import summarize_traces
@@ -512,6 +675,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 1
     if fmt == "json":
         print(json.dumps(rep, indent=2, sort_keys=True))
+    elif drift_mode:
+        print(render_drift_table(rep))
     elif profile_mode:
         print(render_profile_table(rep))
     elif traces_mode:

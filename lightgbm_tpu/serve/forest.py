@@ -144,6 +144,12 @@ class CompiledForest:
     """Immutable inference artifact: stacked SoA forest + cut tables +
     shape-bucketed compiled programs.  Build with :meth:`from_booster`."""
 
+    # class-level defaults so pickled/pre-drift instances behave:
+    # data_fingerprint is the training-data summary riding the artifact,
+    # _drift the (shared) serve-side DriftCollector hook — None = off
+    data_fingerprint = None
+    _drift = None
+
     def __init__(self):
         raise TypeError("use CompiledForest.from_booster()")
 
@@ -257,6 +263,14 @@ class CompiledForest:
         obs.inc("forest_compile_artifacts")
         obs.set_gauge("forest_trees", int(n_models))
         obs.set_gauge("forest_leaves_padded", int(self.num_leaves))
+
+        # drift observatory (obs/drift.py): the training fingerprint
+        # rides from the booster's artifact; ``_drift`` is the serve
+        # collector hook — None (drift=off) keeps the predict path at
+        # exactly one attribute read and zero new programs
+        self.data_fingerprint = getattr(b, "data_fingerprint", None)
+        # pre-publication: from_booster owns the instance exclusively
+        self._drift = None   # graftcheck: disable=lock-shared-attr
 
         # -- fused programs (one compile per bucket size)
         self._binned_jit = CountingJit(self._make_binned_fn(),
@@ -440,7 +454,11 @@ class CompiledForest:
                                            mask)
             obs.devprof.transfer("d2h", "serve", int(raw.nbytes))
             parts.append(np.asarray(raw, np.float64)[:, :n])
-        return np.concatenate(parts, axis=1)
+        raw_all = np.concatenate(parts, axis=1)
+        col = self._drift
+        if col is not None:
+            col.offer(X, raw_all)
+        return raw_all
 
     def _device_scores(self, X) -> Tuple[np.ndarray, np.ndarray]:
         """(raw, transformed) [K, N] f32 via the fully fused raw-float
@@ -469,7 +487,14 @@ class CompiledForest:
                                  int(raw.nbytes) + int(out.nbytes))
             raws.append(np.asarray(raw)[:, :n])
             outs.append(np.asarray(out)[:, :n])
-        return (np.concatenate(raws, axis=1), np.concatenate(outs, axis=1))
+        raw_all = np.concatenate(raws, axis=1)
+        out_all = np.concatenate(outs, axis=1)
+        # drift hook: REAL (unpadded) rows + raw margins, off the device
+        # path — drift=off is this one attribute read (ledger-pinned)
+        col = self._drift
+        if col is not None:
+            col.offer(X, raw_all)
+        return (raw_all, out_all)
 
     def predict(self, X, raw_score: bool = False,
                 device_binning: bool = False) -> np.ndarray:
@@ -568,6 +593,8 @@ class CompiledForest:
             "buckets": list(self.ladder.sizes),
             "max_cuts": int(self.max_cuts),
             "linear": bool(self._has_linear),
+            "fingerprint": self.data_fingerprint is not None,
+            "drift": self._drift is not None,
         }
         if self.device is not None:
             out["device"] = str(self.device)

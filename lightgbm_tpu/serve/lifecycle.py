@@ -222,17 +222,30 @@ class GuardrailPolicy:
       more than ``QUALITY_LOGLOSS_MARGIN``, or AUC lower by more than
       ``QUALITY_AUC_MARGIN`` (votes only when BOTH models have
       >= ``min_samples`` labeled feedback samples — this gate abstains,
-      it never blocks a promote for lack of labels).
+      it never blocks a promote for lack of labels);
+    - ``drift`` — sustained feature PSI: the canary's DriftCollector
+      (obs/drift.py, read through ``drift_source``) reports features
+      whose window PSI stayed above ``drift_threshold`` for
+      consecutive completed windows.  Votes fail naming the offending
+      features; abstains with fewer than 2 completed windows, with no
+      collector (drift=off / no fingerprint in the artifact), or with
+      ``drift_threshold`` 0 (docs/OBSERVABILITY.md §Drift).
     """
 
     _COUNTERS = ("serve_requests", "serve_request_errors_total",
                  "serve_ejections_total")
 
     def __init__(self, min_samples: int = 50, latency_ratio: float = 3.0,
-                 error_rate: float = 0.05):
+                 error_rate: float = 0.05, drift_threshold: float = 0.0,
+                 drift_source=None):
         self.min_samples = max(int(min_samples), 1)
         self.latency_ratio = float(latency_ratio)
         self.error_rate = float(error_rate)
+        self.drift_threshold = float(drift_threshold)
+        # zero-arg callable -> the canary DriftCollector's stats() dict
+        # (or None) — injected by PredictServer so the policy stays
+        # registry-pure and unit-testable
+        self.drift_source = drift_source
 
     def snapshot(self) -> Dict[str, Any]:
         """Cumulative labeled counters + latency histograms for both
@@ -322,6 +335,30 @@ class GuardrailPolicy:
         gates["quality"] = detail
         if q_armed and not q_ok:
             reason = reason or "quality"
+
+        # drift gate: sustained serve-traffic PSI vs the training
+        # fingerprint (obs/drift.py) — one noisy window never votes
+        if self.drift_threshold > 0 and self.drift_source is not None:
+            try:
+                d = self.drift_source()
+            except Exception:  # collector died — gate abstains, loudly
+                obs.inc("lifecycle_drift_source_errors_total")
+                d = None
+            d_armed = bool(d) and int(d.get("windows", 0)) >= 2
+            offenders = (list(d.get("sustained", {}).get("offenders", ()))
+                         if d else [])
+            last = (d or {}).get("last") or {}
+            top = last.get("top") or []
+            d_ok = not (d_armed and offenders)
+            gates["drift"] = {
+                "armed": d_armed, "ok": d_ok,
+                "offenders": offenders,
+                "max_psi": max((t["psi"] for t in top), default=None),
+                "score_psi": last.get("score_psi"),
+                "windows": int(d.get("windows", 0)) if d else 0,
+                "threshold": self.drift_threshold}
+            if not d_ok:
+                reason = reason or "drift"
 
         if reason is not None:
             decision = "fail"
@@ -668,9 +705,16 @@ class PromotionController:
                   ) -> None:
         """Drop the canary and arm the sticky cooldown (exponential
         backoff per consecutive rollback, capped)."""
-        with obs.trace_span("Serve::verdict",
-                            args={"outcome": "rollback", "reason": reason,
-                                  "candidate": self._candidate}):
+        # a drift verdict names its offending features in the trace
+        # event and in per-feature counters — the alarm says WHICH
+        # columns moved, not just that something did
+        offenders = list(((verdict or {}).get("gates", {})
+                          .get("drift", {}) or {}).get("offenders", ()))
+        span_args = {"outcome": "rollback", "reason": reason,
+                     "candidate": self._candidate}
+        if offenders:
+            span_args["drift_features"] = offenders
+        with obs.trace_span("Serve::verdict", args=span_args):
             self.fleet.drop_canary()
             self.manager.clear_slot("canary")
             with self._lock:
@@ -691,8 +735,12 @@ class PromotionController:
                 self._persist()
         obs.inc("lifecycle_rollbacks_total")
         obs.inc(f"lifecycle_rollback_{reason}")
-        log.warning("serve lifecycle: candidate %s ROLLED BACK (%s); "
+        for feat in offenders:
+            obs.inc(obs.labeled_name("lifecycle_drift_offenders_total",
+                                     feature=feat))
+        log.warning("serve lifecycle: candidate %s ROLLED BACK (%s%s); "
                     "cooldown %.1fs", candidate or "?", reason,
+                    (": " + ", ".join(offenders)) if offenders else "",
                     backoff if self.cooldown_s > 0 else 0.0)
 
     # -- introspection / loop --------------------------------------------

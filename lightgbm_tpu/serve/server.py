@@ -72,11 +72,12 @@ import signal
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Mapping, Optional
+from typing import Dict, Mapping, Optional
 
 import numpy as np
 
 from .. import obs
+from ..obs.drift import DriftCollector
 from ..utils import log
 from ..utils.log import LightGBMError
 from .batcher import DeadlineExpired
@@ -257,7 +258,8 @@ class _Handler(BaseHTTPRequestHandler):
             # handler ever learning about them
             self._reply(200, {**registry_stats(),
                               "fleet": srv.fleet.stats(),
-                              "lifecycle": srv.lifecycle_stats()}, req_id)
+                              "lifecycle": srv.lifecycle_stats(),
+                              "drift": srv.drift_stats()}, req_id)
         elif self.path == "/metrics":
             from ..obs import prom
             from ..obs.metrics_server import rank_labels
@@ -469,6 +471,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             try:
                 gen = srv.manager.reload(str(model), target=str(target))
+                # the reload built fresh replica forests: re-attach the
+                # drift collectors (a changed fingerprint gets a fresh
+                # collector — new model, fresh drift history)
+                srv._attach_drift()
                 if str(target) == "canary" and srv.controller is not None:
                     # open the guarded observation window (or, inside
                     # the post-rollback cooldown, roll the candidate
@@ -544,7 +550,11 @@ class PredictServer:
                  lifecycle_latency_ratio: float = 3.0,
                  lifecycle_error_rate: float = 0.05,
                  lifecycle_cooldown_s: float = 60.0,
-                 lifecycle_interval_s: float = 0.25):
+                 lifecycle_interval_s: float = 0.25,
+                 drift: str = "off",
+                 drift_window: float = 30.0,
+                 drift_top_k: int = 5,
+                 lifecycle_drift_threshold: float = 0.25):
         # ingress hardening: request body cap (-> 413) and the NaN/Inf
         # feature policy (reject -> 400 naming the row, or propagate)
         self.max_body_bytes = max(int(max_body_bytes), 0)
@@ -587,12 +597,32 @@ class PredictServer:
         if float(shadow_fraction) > 0.0:
             self.shadow = ShadowScorer(self.fleet,
                                        fraction=float(shadow_fraction))
+        # drift observatory (obs/drift.py, docs/OBSERVABILITY.md §Drift):
+        # per-model streaming collectors hung off the replica forests'
+        # predict hot path — one shared collector per model so fleet
+        # dispatch and micro-batch coalescing aggregate into a single
+        # occupancy.  drift=off builds NOTHING: forests keep _drift=None
+        # (one attribute read, zero new programs, ledger-pinned).
+        if str(drift) not in ("off", "on"):
+            raise ValueError(f"Unknown drift={drift!r} "
+                             f"(expected off or on)")
+        self._drift_on = str(drift) == "on"
+        self.drift_window = float(drift_window)
+        self.drift_top_k = int(drift_top_k)
+        self.lifecycle_drift_threshold = float(lifecycle_drift_threshold)
+        self.drift: Dict[str, DriftCollector] = {}
+        self._drift_lock = threading.Lock()
+        if self._drift_on:
+            self._attach_drift()
         self.controller: Optional[PromotionController] = None
         if float(lifecycle_window_s) > 0.0:
             policy = GuardrailPolicy(
                 min_samples=int(lifecycle_min_samples),
                 latency_ratio=float(lifecycle_latency_ratio),
-                error_rate=float(lifecycle_error_rate))
+                error_rate=float(lifecycle_error_rate),
+                drift_threshold=(float(lifecycle_drift_threshold)
+                                 if self._drift_on else 0.0),
+                drift_source=self._canary_drift_stats)
             self.controller = PromotionController(
                 self.fleet, self.manager, policy,
                 window_s=float(lifecycle_window_s),
@@ -613,6 +643,67 @@ class PredictServer:
             "quality": (self.feedback.quality()
                         if self.feedback is not None else {}),
         }
+
+    # -- drift observatory (obs/drift.py) -------------------------------
+    def _attach_drift(self) -> None:
+        """(Re)wire per-model DriftCollectors onto every live replica
+        forest.  Idempotent and cheap when nothing changed; a reload
+        that swapped in a model with a DIFFERENT fingerprint gets a
+        fresh collector (new model = fresh drift history); models whose
+        artifact carries no fingerprint quietly abstain.  Called at
+        construction, after every successful /reload, and lazily from
+        drift_stats() so promote/rollback set swaps self-heal."""
+        if not self._drift_on:
+            return
+        fleet = self.fleet
+        with fleet._cond:
+            sets = [(rs.model, list(rs.replicas))
+                    for rs in (fleet._primary, fleet._canary)
+                    if rs is not None]
+        with self._drift_lock:
+            live = set()
+            for model, replicas in sets:
+                if not replicas:
+                    continue
+                fp = replicas[0].forest.data_fingerprint
+                if fp is None:
+                    old = self.drift.pop(model, None)
+                    if old is not None:
+                        old.close()
+                    for rep in replicas:
+                        rep.forest._drift = None
+                    continue
+                live.add(model)
+                col = self.drift.get(model)
+                if col is None or col.fingerprint is not fp:
+                    if col is not None:
+                        col.close()
+                    col = DriftCollector(
+                        fp, model=model, window_s=self.drift_window,
+                        top_k=self.drift_top_k,
+                        threshold=self.lifecycle_drift_threshold)
+                    self.drift[model] = col
+                for rep in replicas:
+                    rep.forest._drift = col
+            for model in list(self.drift):
+                if model not in live:
+                    self.drift.pop(model).close()
+
+    def _canary_drift_stats(self):
+        """GuardrailPolicy drift_source: the canary collector's stats
+        dict, or None (drift off / no canary / no fingerprint)."""
+        with self._drift_lock:
+            col = self.drift.get("canary")
+        return col.stats() if col is not None else None
+
+    def drift_stats(self) -> dict:
+        """The ``GET /stats`` ``drift`` block: enabled flag + per-model
+        collector summaries (window trajectory, top offenders, PSI/KL/
+        L-inf, overhead)."""
+        self._attach_drift()
+        with self._drift_lock:
+            return {"enabled": self._drift_on,
+                    **{m: c.stats() for m, c in self.drift.items()}}
 
     def is_ready(self) -> bool:
         return self._ready.is_set() and not self._stop_requested.is_set()
@@ -690,6 +781,10 @@ class PredictServer:
             self.controller.close()
         if self.shadow is not None:
             self.shadow.close()
+        with self._drift_lock:
+            drift_cols, self.drift = list(self.drift.values()), {}
+        for col in drift_cols:
+            col.close()
         if self._warm_thread is not None and self._warm_thread.is_alive():
             # wait out the warm thread's CURRENT bucket compile (it
             # polls _stop_requested between buckets): exiting with an
@@ -833,7 +928,12 @@ def serve_from_config(config, params=None) -> PredictServer:
         lifecycle_error_rate=float(getattr(config, "lifecycle_error_rate",
                                            0.05)),
         lifecycle_cooldown_s=float(getattr(config, "lifecycle_cooldown_s",
-                                           60.0)))
+                                           60.0)),
+        drift=str(getattr(config, "drift", "off") or "off"),
+        drift_window=float(getattr(config, "drift_window", 30.0)),
+        drift_top_k=int(getattr(config, "drift_top_k", 5)),
+        lifecycle_drift_threshold=float(
+            getattr(config, "lifecycle_drift_threshold", 0.25)))
     # the boot model is the first last-good model: a crash before any
     # reload restores to exactly what was serving
     server.manager.note_good(model_path, generation=fleet.generation)

@@ -430,6 +430,41 @@ def skew_predictions(fleet, offset: float,
 
 
 @contextlib.contextmanager
+def skew_features(fleet, features, shift: float,
+                  model: str = "canary") -> Iterator[dict]:
+    """Every row served by EVERY replica of ``model`` arrives with the
+    given feature columns shifted by ``shift`` — upstream feature-
+    pipeline drift (a stale join, a units change) that predictions
+    alone cannot localise.  The drift observatory is the gate that must
+    see it: within a window, ``drift_psi`` for exactly these features
+    crosses threshold and the lifecycle drift gate names them.  The
+    skewed rows flow through the real device path, so the drift
+    collector observes them as served traffic."""
+    import numpy as np
+
+    feats = [int(f) for f in features]
+    off = float(shift)
+
+    def skewed(inner, rows):
+        rows = np.array(rows, copy=True)
+        rows[:, feats] += off
+        return inner(rows)
+
+    with fleet._cond:
+        rs = fleet._primary if model == "primary" else fleet._canary
+        if rs is None:
+            raise ValueError(f"fleet has no {model!r} replica set")
+        ids = [rep.replica_id for rep in rs.replicas]
+    with contextlib.ExitStack() as stack:
+        stats = {"features": feats, "shift": off, "replicas": ids,
+                 "per_replica": [
+                     stack.enter_context(
+                         _patched_predict(fleet, rid, skewed, model))
+                     for rid in ids]}
+        yield stats
+
+
+@contextlib.contextmanager
 def fail_warmup(times: int = 1) -> Iterator[dict]:
     """The next ``times`` ``CompiledForest.warmup`` calls raise — a hot
     reload crashing mid-warm on a replica device.  The reload contract

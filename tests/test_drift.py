@@ -1,0 +1,543 @@
+"""Drift observatory acceptance (obs/drift.py + serve/lifecycle wiring).
+
+The gates from the issue:
+
+- the training-data fingerprint rides the model artifact through a full
+  save -> load -> CompiledForest cycle, its baseline bin occupancy
+  equals an exact offline rebin of the training matrix, and a model
+  saved BEFORE fingerprints existed loads unchanged (section absent =
+  quietly no fingerprint);
+- the streaming serve collector is EXACT: under micro-batch coalescing
+  and fleet dispatch, per-feature occupancy counts equal a
+  single-replica offline rebin of the same rows, bit-for-bit, across
+  the bucket ladder;
+- chaos acceptance: ``skew_features`` shifts a known feature subset in
+  the canary's served traffic — within a window, ``drift_psi`` for
+  exactly those features crosses threshold, the lifecycle drift gate
+  fires a named rollback listing them, and in-distribution primary
+  traffic over the same windows never trips anything;
+- ``drift=off`` is free: predictions bit-identical, ZERO new XLA
+  programs (compile-ledger pinned), one attribute read on the hot path;
+- ``train_delta`` warns (named, PSI vocabulary) on train/serve skew and
+  stays silent on in-distribution refreshes;
+- ``obs-report --drift`` renders the offender table from a collector
+  stats dump.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import engine, obs
+from lightgbm_tpu.obs import compile_ledger, prom, tracing
+from lightgbm_tpu.obs.drift import (DataFingerprint, DriftCollector,
+                                    compare_fingerprints, kl, linf,
+                                    parse_model_fingerprint, psi)
+from lightgbm_tpu.serve import Fleet, GuardrailPolicy, PromotionController
+from lightgbm_tpu.serve.fleet import ModelManager
+from lightgbm_tpu.serve.forest import CompiledForest
+from lightgbm_tpu.testing import faults
+
+pytestmark = [pytest.mark.serve, pytest.mark.drift]
+
+BUCKETS = [16, 64]
+
+
+@pytest.fixture
+def tracer(tmp_path, monkeypatch):
+    """Arm the process tracer (same pattern as tests/test_lifecycle.py)."""
+    path = tmp_path / "trace_events.json"
+    tracing.TRACER.reset()
+    monkeypatch.setenv(tracing.ENV_PATH, str(path))
+    tracing.TRACER.configure()
+    yield path
+    tracing.TRACER.disable()
+    tracing.TRACER.reset()
+    tracing.TRACER.path = None
+
+
+def _train_and_save(tmp_path, name, rounds=3, lr=0.1, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(800, 6))
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "min_data_in_leaf": 20, "learning_rate": lr},
+                    lgb.Dataset(X, label=y), num_boost_round=rounds)
+    path = str(tmp_path / name)
+    bst.save_model(path)
+    return path, X
+
+
+def _forest(path):
+    return CompiledForest.from_booster(lgb.Booster(model_file=path),
+                                       buckets=BUCKETS)
+
+
+def _prom_counter(name):
+    parsed = prom.parse_text(prom.render())
+    vals = [v for n, labels, v in parsed["samples"]
+            if n == f"lightgbm_tpu_{name}" and not labels]
+    return vals[0] if vals else 0.0
+
+
+def _prom_labeled(name, **want):
+    parsed = prom.parse_text(prom.render())
+    vals = [v for n, labels, v in parsed["samples"]
+            if n == f"lightgbm_tpu_{name}" and labels == want]
+    return vals[0] if vals else 0.0
+
+
+def _replicas(fleet, model="primary"):
+    with fleet._cond:
+        rs = fleet._primary if model == "primary" else fleet._canary
+        return list(rs.replicas) if rs is not None else []
+
+
+# ---------------------------------------------------------------------------
+# PSI / KL / L-inf math
+# ---------------------------------------------------------------------------
+
+
+def test_divergence_math_identity_and_known_values():
+    a = np.array([50, 50], np.float64)
+    assert psi(a, a) == 0.0
+    assert kl(a, a) == 0.0
+    assert linf(a, a) == 0.0
+    b = np.array([90, 10], np.float64)
+    # (0.9-0.5)ln(0.9/0.5) + (0.1-0.5)ln(0.1/0.5) = 0.8789...
+    assert abs(psi(a, b) - 0.8789) < 0.01
+    assert psi(a, b) == pytest.approx(psi(b, a))  # PSI is symmetric
+    assert abs(linf(a, b) - 0.4) < 1e-6
+    assert kl(a, b) > 0.0
+    # smoothing keeps an empty expected bin finite, not inf
+    assert np.isfinite(psi(np.array([100, 0]), np.array([50, 50])))
+
+
+def test_coarsened_psi_measures_drift_not_sampling_noise():
+    from lightgbm_tpu.obs.drift import coarsen
+    rng = np.random.RandomState(0)
+    base_vals = rng.normal(size=100_000)
+    edges = np.quantile(base_vals, np.linspace(0, 1, 256)[1:-1])
+    base = np.bincount(np.searchsorted(edges, base_vals), minlength=255)
+    small = np.bincount(np.searchsorted(edges, rng.normal(size=400)),
+                        minlength=255)
+    # full-resolution PSI drowns 400 in-distribution rows in noise...
+    assert psi(base, small) > 0.25
+    # ...grouped PSI reads them as the non-event they are
+    eg, ag = coarsen(base, small)
+    assert eg.size <= 16 and eg.sum() == base.sum() and ag.sum() == 400
+    assert psi(eg, ag) < 0.1
+    # while a genuine shift still blows past the major-shift line
+    moved = np.bincount(np.searchsorted(edges,
+                                        rng.normal(size=400) + 6.0),
+                        minlength=255)
+    eg, ag = coarsen(base, moved)
+    assert psi(eg, ag) > 0.25
+    # short histograms pass through untouched
+    eg, ag = coarsen([1, 2, 3], [3, 2, 1])
+    assert np.array_equal(eg, [1, 2, 3]) and np.array_equal(ag, [3, 2, 1])
+
+
+# ---------------------------------------------------------------------------
+# fingerprint round-trip through the model artifact
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_rides_model_file_and_baseline_is_exact(tmp_path):
+    path, X = _train_and_save(tmp_path, "fp.txt")
+    with open(path) as fh:
+        txt = fh.read()
+    fp = parse_model_fingerprint(txt)
+    assert fp is not None and fp.version == 1
+    assert fp.num_rows == X.shape[0]
+    assert [f["name"] for f in fp.features] == \
+        [f"Column_{i}" for i in range(6)]
+    # baseline occupancy is an EXACT rebin of the training matrix with
+    # the serving bin assignment (NaN->bin 0), not FindBin sample counts
+    for feat, counts in zip(fp.features, fp.rebin_counts(X)):
+        assert np.array_equal(feat["counts"], counts), feat["name"]
+    # text round-trip is lossless where it matters
+    fp2 = DataFingerprint.parse(fp.to_text())
+    assert fp2.num_rows == fp.num_rows
+    for a, b in zip(fp.features, fp2.features):
+        assert a["name"] == b["name"]
+        assert np.array_equal(a["counts"], b["counts"])
+        assert a["missing_rate"] == pytest.approx(b["missing_rate"])
+    # self-comparison is exactly zero drift
+    rep = compare_fingerprints(fp, fp)
+    assert rep["max_psi"] == 0.0
+    assert rep["score_psi"] == 0.0
+    # and the fingerprint reaches the serve artifact
+    forest = _forest(path)
+    assert forest.data_fingerprint is not None
+    assert forest.info()["fingerprint"] is True
+    assert forest.info()["drift"] is False
+
+
+def test_pre_fingerprint_model_loads_unchanged(tmp_path):
+    path, X = _train_and_save(tmp_path, "old.txt")
+    with open(path) as fh:
+        txt = fh.read()
+    start = txt.index("\ndata_fingerprint\n")
+    end = txt.index("end data_fingerprint\n") + len("end data_fingerprint\n")
+    stripped = txt[:start + 1] + txt[end:]
+    assert "data_fingerprint" not in stripped
+    old = str(tmp_path / "stripped.txt")
+    with open(old, "w") as fh:
+        fh.write(stripped)
+    assert parse_model_fingerprint(stripped) is None
+    fa, fb = _forest(path), _forest(old)
+    assert fb.data_fingerprint is None
+    np.testing.assert_array_equal(fa.predict(X[:64]), fb.predict(X[:64]))
+
+
+# ---------------------------------------------------------------------------
+# collector exactness under coalescing + fleet dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_collector_counts_equal_offline_rebin_exactly(tmp_path):
+    path, _X = _train_and_save(tmp_path, "exact.txt")
+    forest = _forest(path)
+    fp = forest.data_fingerprint
+    col = DriftCollector(fp, model="primary", window_s=3600.0,
+                         start_thread=False)
+    fleet = Fleet.build(forest, devices=[None], max_batch=64,
+                        max_delay_s=0.002, warm=False,
+                        watchdog_interval_s=0.0)
+    try:
+        for rep in _replicas(fleet):
+            rep.forest._drift = col
+        rng = np.random.RandomState(7)
+        # odd sizes around the bucket ladder so the micro-batcher both
+        # coalesces and splits; a sprinkle of NaN exercises missing-rate
+        sizes = [1, 3, 17, 40, 64, 5, 64, 2, 31, 16]
+        batches = []
+        for i, n in enumerate(sizes):
+            b = rng.normal(size=(n, 6)).astype(np.float32)
+            if i % 3 == 0:
+                b[0, i % 6] = np.nan
+            batches.append(b)
+        errors = []
+
+        def client(rows):
+            try:
+                fleet.submit(rows, timeout=60.0)
+            except Exception as exc:
+                errors.append(repr(exc))
+        threads = [threading.Thread(target=client, args=(b,))
+                   for b in batches]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        assert not errors, errors
+        for rep in _replicas(fleet):
+            rep.forest._drift = None
+        win = col.flush()
+        assert win is not None
+        total = sum(sizes)
+        assert win["rows"] == total
+        allrows = np.concatenate(batches, axis=0)
+        expected = fp.rebin_counts(allrows)
+        for feat, want in zip(fp.features, expected):
+            got = win["features"][feat["name"]]["counts"]
+            assert np.array_equal(got, want), feat["name"]
+        # score histogram saw every raw margin too
+        assert win["score_psi"] is not None
+        st = col.stats()
+        assert st["rows"] == total and st["dropped"] == 0
+        assert st["windows"] == 1
+    finally:
+        col.close()
+        fleet.close()
+
+
+def test_collector_bounded_buffer_drops_and_counts():
+    assert DataFingerprint.parse("") is None  # absent section -> None
+    # hand-rolled tiny fingerprint via training helper
+    X = np.linspace(0.0, 1.0, 64).reshape(-1, 1)
+    y = (X[:, 0] > 0.5).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "min_data_in_leaf": 5, "num_leaves": 4},
+                    lgb.Dataset(X, label=y), num_boost_round=1)
+    fp = parse_model_fingerprint(bst.model_to_string())
+    col = DriftCollector(fp, model="tiny", window_s=3600.0, max_rows=8,
+                         start_thread=False)
+    assert col.offer(X[:8]) is True
+    assert col.offer(X[:4]) is False       # would exceed the bound
+    win = col.flush()
+    assert win["rows"] == 8
+    assert col.stats()["dropped"] == 4
+    assert col.flush() is None             # empty window closes to None
+    col.close()
+    assert col.offer(X[:2]) is False       # closed collector refuses
+
+
+# ---------------------------------------------------------------------------
+# drift=off is free: bit-identity + flat compile ledger
+# ---------------------------------------------------------------------------
+
+
+def test_drift_off_bit_identical_zero_new_programs(tmp_path):
+    path, X = _train_and_save(tmp_path, "free.txt")
+    forest = _forest(path).warmup(max_bucket=64)
+    assert forest._drift is None           # off is the default
+    base = forest.predict(X[:64])
+    n0 = len(compile_ledger.events())
+    again = forest.predict(X[:64])
+    np.testing.assert_array_equal(base, again)
+    # turning the collector ON changes nothing downstream either:
+    # same bits out, zero new programs — pure host-side observation
+    col = DriftCollector(forest.data_fingerprint, model="pin",
+                         window_s=3600.0, start_thread=False)
+    forest._drift = col
+    observed = forest.predict(X[:64])
+    forest._drift = None
+    np.testing.assert_array_equal(base, observed)
+    assert len(compile_ledger.events()) == n0
+    assert col.flush()["rows"] == 64
+    col.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: skewed canary -> drift gate names the features
+# ---------------------------------------------------------------------------
+
+
+def test_skewed_canary_trips_drift_gate_names_offenders(tmp_path, tracer):
+    path_a, _ = _train_and_save(tmp_path, "a.txt", rounds=3)
+    path_b, _ = _train_and_save(tmp_path, "b.txt", rounds=4, lr=0.2)
+    fa, fb = _forest(path_a), _forest(path_b)
+    fleet = Fleet.build(fa, devices=[None], canary_forest=fb,
+                        canary_weight=0.5, max_batch=64, max_delay_s=0.0,
+                        warm=False, watchdog_interval_s=0.0)
+    col_c = DriftCollector(fb.data_fingerprint, model="canary",
+                           window_s=3600.0, threshold=0.25,
+                           consecutive=2, start_thread=False)
+    col_p = DriftCollector(fa.data_fingerprint, model="primary",
+                           window_s=3600.0, threshold=0.25,
+                           consecutive=2, start_thread=False)
+    manager = ModelManager(fleet, state_file=str(tmp_path / "state.json"))
+    policy = GuardrailPolicy(min_samples=10_000, latency_ratio=100.0,
+                             error_rate=1.0, drift_threshold=0.25,
+                             drift_source=col_c.stats)
+    ctrl = PromotionController(fleet, manager, policy, window_s=30.0,
+                               max_window_s=60.0, cooldown_s=60.0,
+                               interval_s=3600.0)
+    rng = np.random.RandomState(11)
+
+    def serve_round(n_batches=24):
+        for _ in range(n_batches):
+            fleet.submit(rng.normal(size=(32, 6)).astype(np.float32),
+                         timeout=60.0)
+    try:
+        for rep in _replicas(fleet, "canary"):
+            rep.forest._drift = col_c
+        for rep in _replicas(fleet, "primary"):
+            rep.forest._drift = col_p
+        ctrl.begin(path_b, 2)
+        r0 = _prom_counter("lifecycle_rollback_drift")
+        o0 = _prom_labeled("lifecycle_drift_offenders_total",
+                           feature="Column_3")
+        with faults.skew_features(fleet, [1, 3], 6.0, model="canary"):
+            # two completed windows of skewed canary traffic — the gate
+            # abstains on one (a noisy window never votes rollback)
+            serve_round()
+            assert col_c.flush() is not None
+            assert col_p.flush() is not None
+            ctrl.tick()
+            assert ctrl.stats()["last_verdict"] is None or \
+                ctrl.stats()["last_verdict"]["reason"] != "drift"
+            serve_round()
+            assert col_c.flush() is not None
+            assert col_p.flush() is not None
+            # exactly the skewed features are sustained offenders; the
+            # in-distribution primary stream never trips anything
+            assert col_c.sustained_offenders() == ["Column_1", "Column_3"]
+            assert col_p.sustained_offenders() == []
+            for w in col_p.stats()["trajectory"]:
+                assert w["max_psi"] < 0.25, w
+            ctrl.tick()
+        verdict = ctrl.stats()["last_verdict"]
+        assert verdict is not None and verdict["outcome"] == "rollback"
+        assert verdict["reason"] == "drift"
+        gate = verdict["verdict"]["gates"]["drift"]
+        assert gate["armed"] and not gate["ok"]
+        assert gate["offenders"] == ["Column_1", "Column_3"]
+        assert gate["max_psi"] is not None and gate["max_psi"] > 0.25
+        assert not fleet.has_canary()
+        assert _prom_counter("lifecycle_rollback_drift") == r0 + 1
+        assert _prom_labeled("lifecycle_drift_offenders_total",
+                             feature="Column_3") == o0 + 1
+        # published gauges name the moved columns for the scrape
+        gauges = obs.snapshot()["gauges"]
+        key = obs.labeled_name("drift_psi", model="canary",
+                               feature="Column_1")
+        assert float(gauges[key]) > 0.25
+        # the verdict trace span carries the feature names
+        spans = [e for e in tracing.TRACER.events()
+                 if e.get("name") == "Serve::verdict"]
+        assert any((e.get("args") or {}).get("reason") == "drift"
+                   and (e.get("args") or {}).get("drift_features")
+                   == ["Column_1", "Column_3"] for e in spans), spans
+    finally:
+        ctrl.close()
+        col_c.close()
+        col_p.close()
+        fleet.close()
+
+
+def test_drift_gate_abstains_without_windows_or_source():
+    policy = GuardrailPolicy(min_samples=10_000, drift_threshold=0.25,
+                             drift_source=lambda: None)
+    verdict = policy.evaluate(policy.snapshot(), None)
+    gate = verdict["gates"]["drift"]
+    assert gate["armed"] is False and gate["ok"] is True
+    assert verdict["decision"] != "fail"
+    # a dying collector abstains loudly, never crashes the verdict
+    e0 = _prom_counter("lifecycle_drift_source_errors_total")
+
+    def boom():
+        raise RuntimeError("collector died")
+    policy = GuardrailPolicy(min_samples=10_000, drift_threshold=0.25,
+                             drift_source=boom)
+    verdict = policy.evaluate(policy.snapshot(), None)
+    assert verdict["gates"]["drift"]["ok"] is True
+    assert verdict["decision"] != "fail"
+    assert _prom_counter("lifecycle_drift_source_errors_total") == e0 + 1
+
+
+# ---------------------------------------------------------------------------
+# serve wiring: /stats drift block
+# ---------------------------------------------------------------------------
+
+
+def test_server_stats_drift_block(tmp_path):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.serve.server import serve_from_config
+
+    path, X = _train_and_save(tmp_path, "srv.txt")
+    conf = {"task": "serve", "input_model": path, "serve_port": 0,
+            "serve_state_file": str(tmp_path / "srv_state.json"),
+            "serve_max_batch": 64, "predict_buckets": [16, 64],
+            "serve_watchdog_ms": 0, "drift": "on",
+            "drift_window": 3600.0, "drift_top_k": 3, "verbose": -1}
+    srv = serve_from_config(Config(dict(conf))).start()
+    try:
+        assert srv._ready.wait(120.0)
+        assert "primary" in srv.drift
+        host, port = srv.address
+        body = json.dumps({"rows": X[:5].tolist()}).encode()
+        req = urllib.request.Request(
+            f"http://{host}:{port}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        json.loads(urllib.request.urlopen(req, timeout=30).read())
+        srv.drift["primary"].flush()
+        stats = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/stats", timeout=30).read())
+        blk = stats["drift"]
+        assert blk["enabled"] is True
+        assert blk["primary"]["rows"] >= 5
+        assert blk["primary"]["windows"] >= 1
+        assert blk["primary"]["last"]["top"], blk
+    finally:
+        srv.stop()
+
+
+def test_drift_params_validated():
+    from lightgbm_tpu.config import Config
+    assert Config({"drift": "on"}).drift == "on"
+    with pytest.raises(ValueError):
+        Config({"drift": "sideways"})
+    with pytest.raises(ValueError):
+        Config({"drift_window": 0})
+    with pytest.raises(ValueError):
+        Config({"drift_top_k": 0})
+    with pytest.raises(ValueError):
+        Config({"lifecycle_drift_threshold": -0.1})
+
+
+# ---------------------------------------------------------------------------
+# bench_regress passthrough (informational `drift` BENCH block)
+# ---------------------------------------------------------------------------
+
+
+def test_bench_regress_passes_drift_block_through(tmp_path, capsys):
+    import importlib.util
+    import pathlib
+    spec = importlib.util.spec_from_file_location(
+        "bench_regress", pathlib.Path(__file__).resolve().parent.parent
+        / "tools" / "bench_regress.py")
+    bench_regress = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_regress)
+
+    # candidate carries a drift block; the old baseline predates it —
+    # informational passthrough, never a gate, old baselines unaffected
+    base = {"metric": "m", "value": 10.0, "unit": "iters/sec"}
+    cand = {"metric": "m", "value": 10.2, "unit": "iters/sec",
+            "drift": {"windows": 1, "rows": 4096, "dropped": 0,
+                      "overhead_s": 0.003, "max_psi": 0.01,
+                      "score_psi": 0.004}}
+    b, c = tmp_path / "b.json", tmp_path / "c.json"
+    b.write_text(json.dumps(base))
+    c.write_text(json.dumps(cand))
+    rc = bench_regress.main(["--baseline", str(b), "--candidate", str(c),
+                             "--threshold", "5"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    verdict = json.loads(out)
+    assert rc == 0 and verdict["ok"]
+    assert verdict["drift_candidate"]["max_psi"] == 0.01
+    assert "drift_baseline" not in verdict
+
+
+# ---------------------------------------------------------------------------
+# train_delta skew check + obs-report --drift
+# ---------------------------------------------------------------------------
+
+
+def test_train_delta_warns_on_skew_silent_in_distribution(tmp_path):
+    path, X = _train_and_save(tmp_path, "base.txt")
+    rng = np.random.RandomState(3)
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+              "min_data_in_leaf": 20}
+
+    def fresh(shift):
+        Xf = rng.normal(size=(800, 6))
+        Xf[:, 2] += shift
+        yf = (Xf[:, 0] + 0.3 * Xf[:, 1] > 0).astype(np.float64)
+        return lgb.Dataset(Xf, label=yf)
+
+    w0 = _prom_counter("drift_skew_warnings_total")
+    engine.train_delta(path, fresh(0.0), num_trees=2, params=params)
+    assert _prom_counter("drift_skew_warnings_total") == w0  # in-dist: quiet
+    engine.train_delta(path, fresh(8.0), num_trees=2, params=params)
+    assert _prom_counter("drift_skew_warnings_total") == w0 + 1
+
+
+def test_obs_report_drift_table(tmp_path):
+    from lightgbm_tpu.obs.report import (drift_summary_from_files,
+                                         render_drift_table)
+    path, X = _train_and_save(tmp_path, "rep.txt")
+    fp = _forest(path).data_fingerprint
+    col = DriftCollector(fp, model="canary", window_s=3600.0,
+                         threshold=0.25, start_thread=False)
+    skewed = np.array(X[:400], copy=True)
+    skewed[:, 4] += 9.0
+    col.offer(skewed)
+    col.flush()
+    dump = tmp_path / "drift_stats.json"
+    dump.write_text(json.dumps(col.stats()))
+    col.close()
+    rep = drift_summary_from_files([str(dump)], top_k=3)
+    table = render_drift_table(rep)
+    assert "canary" in table
+    assert "Column_4" in table
+    top = rep["models"]["canary"]["offenders"] \
+        if "models" in rep else rep["canary"]["offenders"]
+    assert top[0]["feature"] == "Column_4"
+    assert top[0]["psi"] > 0.25

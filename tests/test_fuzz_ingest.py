@@ -190,3 +190,66 @@ def test_linear_tree_sections_never_escape_lightgbmerror():
         except Exception as exc:  # noqa: BLE001 - the contract
             pytest.fail(f"linear mutation {i}: {type(exc).__name__} "
                         f"escaped Tree.from_string: {exc!r}")
+
+
+def test_fingerprint_sections_never_escape_lightgbmerror(tmp_path):
+    """Drift fingerprint tail sections (docs/OBSERVABILITY.md §Drift):
+    a real saved model carrying a ``data_fingerprint`` section, then
+    mutated — every outcome must be a clean parse, a clean absence
+    (``None``), or a NAMED ``LightGBMError``.  30 cases: 10 whole-text
+    mutations plus 20 biased at the fingerprint tail (intact tree body,
+    so the section parser is what's exercised), each driven through
+    both ``parse_model_fingerprint`` and the full ``Booster`` loader."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs.drift import parse_model_fingerprint
+
+    rng0 = np.random.RandomState(5)
+    X = rng0.normal(size=(80, 3))
+    y = (X[:, 0] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "min_data_in_leaf": 5, "num_leaves": 4},
+                    lgb.Dataset(X, label=y), num_boost_round=1)
+    seed = bst.model_to_string().encode()
+    assert b"\ndata_fingerprint\n" in seed
+    body, tail = seed.split(b"\ndata_fingerprint\n", 1)
+    body += b"\n"
+    tail = b"data_fingerprint\n" + tail
+    rng = np.random.RandomState(4321)
+    cases = [(_mutate(seed, rng), "whole") for _ in range(10)]
+    cases += [(body + _mutate(tail, rng), "tail") for _ in range(20)]
+    p = tmp_path / "fp_fuzz.txt"
+    for i, (blob, what) in enumerate(cases):
+        text = blob.decode("utf-8", errors="replace")
+        try:
+            fp = parse_model_fingerprint(text)
+            assert fp is None or fp.num_rows >= 0
+        except LightGBMError:
+            pass
+        except Exception as exc:  # noqa: BLE001 - the contract
+            pytest.fail(f"fingerprint mutation {i} ({what}): "
+                        f"{type(exc).__name__} escaped "
+                        f"parse_model_fingerprint: {exc!r}")
+        if what == "tail":
+            # intact tree body + garbled section through the FULL model
+            # loader: load cleanly or refuse by name, never crash
+            p.write_text(text)
+            try:
+                lgb.Booster(model_file=str(p))
+            except LightGBMError:
+                pass
+            except Exception as exc:  # noqa: BLE001 - the contract
+                pytest.fail(f"fingerprint mutation {i}: "
+                            f"{type(exc).__name__} escaped the Booster "
+                            f"loader: {exc!r}")
+    # absent section = clean absence, and a pre-fingerprint model file
+    # loads with predictions unchanged
+    start = seed.index(b"\ndata_fingerprint\n")
+    end = seed.index(b"end data_fingerprint\n") \
+        + len(b"end data_fingerprint\n")
+    stripped = (seed[:start + 1] + seed[end:]).decode()
+    assert "data_fingerprint" not in stripped
+    assert parse_model_fingerprint(stripped) is None
+    old = tmp_path / "pre_fingerprint.txt"
+    old.write_text(stripped)
+    loaded = lgb.Booster(model_file=str(old))
+    np.testing.assert_array_equal(loaded.predict(X), bst.predict(X))

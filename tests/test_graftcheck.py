@@ -517,6 +517,54 @@ def test_param_docs_drift_trips(tmp_path):
                for m in msgs)
 
 
+# -- family: metrics -----------------------------------------------------
+
+def test_metrics_undocumented_series_trips(tmp_path):
+    root = _tree(tmp_path, {"obs/widget.py": """
+        from . import registry
+
+        def publish(spins):
+            registry.inc("widget_spins_total", spins)
+            registry.set_gauge("widget_temperature", 451)
+            registry.inc("widget_" + "dynamic")   # not a literal: skipped
+    """})
+    (root / "docs").mkdir()
+    (root / "docs" / "OBSERVABILITY.md").write_text(
+        "# Observability\n\n`widget_temperature` — a documented gauge.\n")
+    report = run_checks(root, families=["metrics"])
+    msgs = [f.message for f in report.findings if f.rule == "metrics-docs"]
+    assert any("widget_spins_total" in m for m in msgs), report.findings
+    assert not any("widget_temperature" in m for m in msgs)
+    assert not any("dynamic" in m for m in msgs)
+
+
+def test_metrics_abstains_without_docs_file(tmp_path):
+    root = _tree(tmp_path, {"obs/widget.py": """
+        from . import registry
+
+        def publish():
+            registry.inc("widget_spins_total")
+    """})
+    report = run_checks(root, families=["metrics"])
+    assert report.findings == []
+
+
+def test_metrics_suppression_round_trips(tmp_path):
+    root = _tree(tmp_path, {"obs/widget.py": """
+        from . import registry
+
+        def publish():
+            registry.inc("widget_spins_total")  # graftcheck: disable=metrics-docs
+            registry.inc("widget_faults_total")
+    """})
+    (root / "docs").mkdir()
+    (root / "docs" / "OBSERVABILITY.md").write_text("# Observability\n")
+    report = run_checks(root, families=["metrics"])
+    assert [f.rule for f in report.findings] == ["metrics-docs"]
+    assert "widget_faults_total" in report.findings[0].message
+    assert report.suppressed_counts() == {"metrics-docs": 1}
+
+
 # -- family: ingress -----------------------------------------------------
 
 def test_ingress_assert_trips(tmp_path):

@@ -343,7 +343,8 @@ def test_serve_metrics_and_full_stats():
         # new metric names can never drift out
         stats = json.loads(_get(base + "/stats")[0])
         assert set(stats) == {"counters", "gauges", "histograms", "fleet",
-                              "lifecycle"}
+                              "lifecycle", "drift"}
+        assert stats["drift"] == {"enabled": False}  # off is the default
         assert stats["fleet"]["generation"] >= 1
         assert stats["fleet"]["replicas"], "fleet topology missing"
         assert stats["counters"]["serve_requests"] >= 3

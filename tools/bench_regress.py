@@ -253,7 +253,10 @@ def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
         # trees-to-target vs the constant run, per-round fit seconds,
         # leaf-fit fallback rate.  Informational: accuracy trade-offs are
         # workload-dependent, never gated, never required
-        for key in ("efb", "screening", "linear"):
+        # PR 19: drift observatory bill (docs/OBSERVABILITY.md §Drift) —
+        # window PSI summary + collector compute seconds from --mode
+        # predict.  Informational: old baselines have no drift block
+        for key in ("efb", "screening", "linear", "drift"):
             blk = obj.get(key)
             if isinstance(blk, dict) and blk:
                 verdict[f"{key}_{side}"] = blk

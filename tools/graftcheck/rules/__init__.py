@@ -18,6 +18,8 @@ lifecycle  thread-lifecycle   threads daemonized or joined
            wall-clock         monotonic clocks on deadline math
 phases     phase-taxonomy     host/device phase taxonomy in sync
 params     param-docs         config params documented + rendered
+metrics    metrics-docs       registry series names documented in
+                              docs/OBSERVABILITY.md
 resource   resource-raw-open  write-mode open() routes through
                               utils/diskguard.py (disk-full-safe sinks)
 timing     timing-async-      no clock deltas around bare jit dispatch
@@ -26,5 +28,5 @@ timing     timing-async-      no clock deltas around bare jit dispatch
 ========== ================== ==========================================
 """
 
-from . import (ingress, jit, lifecycle, locks, params,  # noqa: F401
-               phases, resource, timing, tracer)
+from . import (ingress, jit, lifecycle, locks, metrics,  # noqa: F401
+               params, phases, resource, timing, tracer)

@@ -1,0 +1,66 @@
+"""Registry/doc consistency rules (family ``metrics``).
+
+``metrics-docs`` — every registry series name a package module writes
+(an ``inc`` / ``set_gauge`` / ``observe`` / ``labeled_name`` call whose
+first argument is a string literal) must appear in
+``docs/OBSERVABILITY.md``.  Series names are the observability API:
+dashboards, the lifecycle gates, and ``obs-report`` all key on them, and
+a name that exists only in code is a metric nobody can discover.  PRs
+add series faster than they add prose — this rule is what keeps the
+metrics reference complete instead of drifting one PR at a time.
+
+Names built by concatenation (``"devprof_samples_" + prog``) are not
+literals and audit at whatever literal site publishes their family
+instead; fully dynamic names need an inline suppression.  Everything is
+read statically (AST), so the rule never imports the package or jax.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Finding, Project, family
+
+# the registry's writer surface (obs/registry.py + obs/prom.py), plus
+# the aliased forms modules import them under
+_WRITERS = {"inc", "set_gauge", "observe", "labeled_name",
+            "_inc", "_set_gauge", "_observe"}
+
+
+def _call_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+@family("metrics")
+def check_metrics_docs(project: Project) -> List[Finding]:
+    docs_path = project.root / "docs" / "OBSERVABILITY.md"
+    if not docs_path.exists():
+        return []   # fixture trees without the audited docs file
+    docs = docs_path.read_text()
+    findings: List[Finding] = []
+    seen = set()
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_name(node.func) not in _WRITERS or not node.args:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue
+            name = arg.value
+            if name in docs or (mod.rel, name) in seen:
+                continue
+            seen.add((mod.rel, name))
+            findings.append(Finding(
+                "metrics-docs", mod.rel, node.lineno,
+                f"registry series {name!r} is not documented in "
+                f"docs/OBSERVABILITY.md — every published series must "
+                f"be discoverable there"))
+    return findings
