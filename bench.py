@@ -375,6 +375,39 @@ def predict_main(concurrency: int = 0) -> None:
             "p50_ms": round(float(np.percentile(lat, 50)), 3),
             "p99_ms": round(float(np.percentile(lat, 99)), 3),
         }
+    # small-batch latency sweep on BOTH walk strategies (docs/SERVING.md
+    # strategy matrix): batch 1/16/64/256 is the p50/p99 regime single
+    # user requests live in; tools/bench_regress.py --latency-threshold
+    # gates p99 per (strategy, batch) point of this block
+    sweep_sizes = [int(s) for s in os.environ.get(
+        "BENCH_LATENCY_BATCHES", "1,16,64,256").split(",")]
+    sweep_sizes = [s for s in sweep_sizes if s <= rows] or [1]
+    sweep_calls = int(os.environ.get("BENCH_LATENCY_CALLS", 15))
+    latency_sweep = {"active": forest.walk_strategy, "strategies": {}}
+    for strat in ("gather", "fused"):
+        if forest.walk_strategy == strat:
+            f2 = forest
+        else:
+            f2 = CompiledForest.from_booster(
+                booster, buckets=default_ladder(16, max(sizes)),
+                serve_walk=strat)
+            f2.warmup(max_bucket=max(sweep_sizes))
+        fn = f2.batched_fn()
+        pts = {}
+        for size in sweep_sizes:
+            lat = []
+            for i in range(sweep_calls):
+                off = (i * size) % max(rows - size + 1, 1)
+                t0 = time.time()
+                raw, out = fn(X32[off:off + size])
+                np.asarray(out)                  # block until materialized
+                lat.append((time.time() - t0) * 1000.0)
+            pts[str(size)] = {
+                "p50_ms": round(float(np.percentile(lat, 50)), 3),
+                "p99_ms": round(float(np.percentile(lat, 99)), 3),
+            }
+        latency_sweep["strategies"][strat] = pts
+
     drift_block = None
     if drift_col is not None:
         forest._drift = None
@@ -411,6 +444,7 @@ def predict_main(concurrency: int = 0) -> None:
         "unit": "rows/sec",
         "vs_baseline": None,
         "batches": batches,
+        "latency_sweep": latency_sweep,
         "warmup_s": round(t_warm, 3),
         "compile_events": compile_ledger.summary(5),
     }

@@ -120,6 +120,21 @@ DESC = {
                                  "train_delta skew-warning bar "
                                  "(0 disables the gate; 0.25 = classic "
                                  "major-shift reading)",
+    "serve_walk": "auto | fused | gather — forest-walk serving strategy "
+                  "(docs/SERVING.md §Serving strategies): 'fused' runs "
+                  "the single-pass Pallas walk kernel with the forest "
+                  "pinned in VMEM, 'gather' keeps the classic per-depth "
+                  "gather programs byte-identical, 'auto' picks fused "
+                  "when the forest's VMEM footprint fits the "
+                  "LIGHTGBM_TPU_WALK_VMEM_BYTES budget (gather "
+                  "otherwise, and always off-TPU)",
+    "serve_quantize_leaves": "task=serve: with serve_walk=fused, "
+                             "accumulate leaf values in bfloat16 when "
+                             "the per-class worst-case rounding bound "
+                             "stays within QUANTIZE_LEAF_ATOL — "
+                             "otherwise falls back to float32 and "
+                             "increments forest_quantize_fallback "
+                             "(docs/SERVING.md §Bin quantization)",
     "serve_max_body_bytes": "task=serve: request body size cap — larger "
                             "payloads are shed with 413 before any "
                             "parsing or device time (0 = no cap)",

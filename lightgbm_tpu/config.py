@@ -249,6 +249,10 @@ _DEFAULTS: Dict[str, Any] = {
     "serve_max_batch": 8192,    # micro-batcher row cap per device batch
     "serve_max_delay_ms": 5.0,  # micro-batch coalescing deadline
     "predict_buckets": [],      # batch bucket ladder ([] = powers of two)
+    "serve_walk": "auto",       # forest walk strategy: auto | fused
+                                # (Pallas VMEM kernel) | gather (XLA)
+    "serve_quantize_leaves": False,  # bf16 fused leaf tables behind the
+                                     # QUANTIZE_LEAF_ATOL pin
     # serving fleet (serve/fleet.py: replicas, admission, canary)
     "serve_replicas": 0,        # device replicas (0 = all local devices)
     "serve_queue_depth": 128,   # pending requests per replica (0 = no cap)
@@ -532,6 +536,10 @@ class Config:
                              "(0 disables the metrics listener)")
         if v["serve_max_delay_ms"] < 0:
             raise ValueError("serve_max_delay_ms must be >= 0")
+        if v["serve_walk"] not in ("auto", "fused", "gather"):
+            raise ValueError(
+                f"Unknown serve_walk {v['serve_walk']} "
+                "(expected auto, fused or gather)")
         if any(b <= 0 for b in v["predict_buckets"]):
             raise ValueError("predict_buckets must be positive sizes")
         if v["serve_replicas"] < 0:

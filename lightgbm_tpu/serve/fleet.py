@@ -427,7 +427,12 @@ class Fleet:
                     return False
                 rep.forest.warmup(max_bucket=self.max_batch)
                 continue
-            sizes = [s for s in ladder.sizes if s <= self.max_batch] \
+            # cap at the bucket a max_batch-row request DISPATCHES to
+            # (bucket_for rounds up): a max_batch between two ladder
+            # rungs routes its largest admitted requests to the rung
+            # above, which a plain <= max_batch trim would leave cold
+            cap = ladder.bucket_for(self.max_batch)
+            sizes = [s for s in ladder.sizes if s <= cap] \
                 or list(ladder.sizes)[:1]
             for s in sizes:
                 if should_abort is not None and should_abort():

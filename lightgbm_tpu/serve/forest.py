@@ -29,7 +29,19 @@ structure-of-arrays forest traversed data-parallel in one fused program.
   ``warmup()`` pre-compiles every bucket so arbitrary request sizes
   never hit XLA on the hot path.  Per-bucket compile counters land in
   the obs registry (``serve_forest_compiles_bucket_<B>`` /
-  ``predict_forest_compiles_bucket_<B>``).
+  ``predict_forest_compiles_bucket_<B>``);
+- two WALK STRATEGIES serve the same artifact (``serve_walk`` param,
+  docs/SERVING.md): ``gather`` is the XLA per-level gather walk above;
+  ``fused`` routes through ``ops/pallas_walk.py``'s Pallas kernel that
+  pins the SoA forest in VMEM and walks all trees per row block in one
+  pass (programs ``predict_forest_walk`` / ``serve_forest_walk``).
+  ``auto`` picks fused on TPU when the forest's estimated VMEM
+  footprint fits.  Every predict entry point routes through
+  ``_dispatch_binned`` / ``_dispatch_raw`` (enforced by graftcheck rule
+  ``serve-strategy-parity``), so replicas, warmup, fleet dispatch and
+  hedging gate the strategy with zero extra plumbing — and
+  ``serve_walk=gather`` keeps programs and outputs byte-identical to
+  the pre-strategy artifact.
 
 ``Booster.compile()`` / the large-array fast path in
 ``Booster._predict_array`` feed host-binned (f64-exact) bins to the same
@@ -39,6 +51,7 @@ artifact and one compiled program universe.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -146,21 +159,39 @@ class CompiledForest:
 
     # class-level defaults so pickled/pre-drift instances behave:
     # data_fingerprint is the training-data summary riding the artifact,
-    # _drift the (shared) serve-side DriftCollector hook — None = off
+    # _drift the (shared) serve-side DriftCollector hook — None = off;
+    # pre-strategy pickles serve via the gather walk with f32 leaves
     data_fingerprint = None
     _drift = None
+    walk_strategy = "gather"
+    leaf_dtype = "float32"
+    _walk_dev = None
+    _walk_aff_dev = None
+
+    #: documented bound on the fused walk's quantized-leaf output error
+    #: (docs/SERVING.md): ``serve_quantize_leaves`` only sticks when the
+    #: worst-case bf16 leaf-rounding perturbation stays within it
+    QUANTIZE_LEAF_ATOL = 1e-3
 
     def __init__(self):
         raise TypeError("use CompiledForest.from_booster()")
 
     @classmethod
     def from_booster(cls, booster, num_iteration: int = -1,
-                     buckets: Optional[Sequence[int]] = None
+                     buckets: Optional[Sequence[int]] = None,
+                     serve_walk: Optional[str] = None,
+                     quantize_leaves: Optional[bool] = None
                      ) -> "CompiledForest":
         """Freeze ``booster`` (a ``Booster`` or a ``models/gbdt.py``
         engine) into a CompiledForest.  ``num_iteration`` limits the
         forest like ``Booster.predict``; ``buckets`` overrides the batch
-        bucket ladder (default: powers of two, 16..65536)."""
+        bucket ladder (default: powers of two, 16..65536).
+
+        ``serve_walk`` picks the walk strategy (``auto``/``fused``/
+        ``gather``; None reads the booster's config, defaulting to
+        ``auto``) and ``quantize_leaves`` opts fused leaf tables into
+        bf16 storage behind the :data:`QUANTIZE_LEAF_ATOL` pin
+        (docs/SERVING.md)."""
         import jax.numpy as jnp
 
         b = getattr(booster, "_booster", booster)
@@ -229,10 +260,11 @@ class CompiledForest:
                     for a, z in zip(arrs, zero))
             stacks.append(arrs)
         self.trees_per_class = T
-        self._tree_dev = tuple(
-            jnp.asarray(np.stack([s[i] for s in stacks], axis=0))
-            for i in range(6))
+        stacked = tuple(np.stack([s[i] for s in stacks], axis=0)
+                        for i in range(6))
+        self._tree_dev = tuple(jnp.asarray(a) for a in stacked)
         self._lin_dev = None
+        lin_stacked = None
         if self._has_linear:
             lin_stacks = []
             for ts in per_class:
@@ -247,9 +279,9 @@ class CompiledForest:
                         [lft, np.full((pad,) + lft.shape[1:], -1,
                                       np.int32)], axis=0)
                 lin_stacks.append((lcf, lft))
-            self._lin_dev = tuple(
-                jnp.asarray(np.stack([s[i] for s in lin_stacks], axis=0))
-                for i in range(2))
+            lin_stacked = tuple(np.stack([s[i] for s in lin_stacks],
+                                         axis=0) for i in range(2))
+            self._lin_dev = tuple(jnp.asarray(a) for a in lin_stacked)
         # default placement (first local device); serve/fleet.py pins
         # per-replica copies with to_device()
         self.device = None
@@ -276,7 +308,157 @@ class CompiledForest:
         self._binned_jit = CountingJit(self._make_binned_fn(),
                                        "predict_forest")
         self._raw_jit = CountingJit(self._make_raw_fn(), "serve_forest")
+
+        # -- walk strategy (docs/SERVING.md): gather keeps everything
+        # above byte-identical (no new arrays, jits, or programs); fused
+        # additionally builds the Pallas walk operands + its own
+        # bucket-keyed programs
+        cfg = getattr(booster, "config", None)
+        if serve_walk is None:
+            serve_walk = str(getattr(cfg, "serve_walk", "auto") or "auto")
+        if quantize_leaves is None:
+            quantize_leaves = bool(getattr(cfg, "serve_quantize_leaves",
+                                           False))
+        if serve_walk not in ("auto", "fused", "gather"):
+            raise LightGBMError(
+                f"serve_walk must be auto, fused or gather "
+                f"(got {serve_walk!r})")
+        self.serve_walk_requested = serve_walk
+        self._quantize_requested = bool(quantize_leaves)
+        self.walk_strategy = self._resolve_walk_strategy()
+        if self.walk_strategy == "fused":
+            self._build_fused_walk(stacked, lin_stacked)
         return self
+
+    # ------------------------------------------------------------------
+    # fused walk strategy (ops/pallas_walk.py)
+    def walk_vmem_bytes(self) -> int:
+        """Estimated VMEM residency of the fused walk's operands — the
+        ``serve_walk=auto`` sizing input (docs/SERVING.md)."""
+        from ..ops.pallas_walk import walk_vmem_bytes
+        return walk_vmem_bytes(self.num_class, self.trees_per_class,
+                               self.num_leaves, self.num_features,
+                               self.max_cuts, self._has_linear)
+
+    def _resolve_walk_strategy(self) -> str:
+        """``fused``/``gather`` from the requested mode: ``auto`` takes
+        the kernel only on TPU and only when the pinned operands fit the
+        VMEM budget (``LIGHTGBM_TPU_WALK_VMEM_BYTES``, default 8 MiB of
+        the ~16 MiB/core)."""
+        from ..ops.pallas_walk import on_tpu
+        req = self.serve_walk_requested
+        if req != "auto":
+            return req
+        if not on_tpu():
+            return "gather"
+        budget = int(os.environ.get("LIGHTGBM_TPU_WALK_VMEM_BYTES",
+                                    8 << 20))
+        return "fused" if self.walk_vmem_bytes() <= budget else "gather"
+
+    def _build_fused_walk(self, stacked, lin_stacked) -> None:
+        """Freeze-time fused-walk operands + per-strategy programs."""
+        import jax.numpy as jnp
+        from ..ops.pallas_walk import (bin_index_dtype, build_affine_tables,
+                                       build_walk_tables, on_tpu)
+
+        sf, sb, ic, lc, rc, lv = stacked
+        fsel, thr, icat, paths, lvf = build_walk_tables(
+            sf, sb, ic, lc, rc, lv, self.num_features, int(self._nan_bin))
+        self._bin_dtype = bin_index_dtype(int(self._nan_bin))
+        self.leaf_dtype = "float32"
+        lv_dtype = jnp.float32
+        if self._quantize_requested:
+            # atol pin: every row takes exactly ONE leaf per tree, so
+            # the bf16-storage output perturbation is bounded by the
+            # per-class sum over trees of the max per-leaf rounding
+            # error.  Past QUANTIZE_LEAF_ATOL the forest stays f32 and
+            # the named fallback counter records why.
+            lv_q = np.asarray(jnp.asarray(lvf, jnp.bfloat16)
+                              .astype(jnp.float32))
+            per_tree = np.abs(lv_q - lvf).max(axis=1)
+            bound = float(per_tree.reshape(
+                self.num_class, self.trees_per_class).sum(axis=1).max()
+                if per_tree.size else 0.0)
+            if bound <= self.QUANTIZE_LEAF_ATOL:
+                self.leaf_dtype = "bfloat16"
+                lv_dtype = jnp.bfloat16
+            else:
+                obs.inc("forest_quantize_fallback")
+        self._walk_dev = (jnp.asarray(fsel), jnp.asarray(thr),
+                          jnp.asarray(icat), jnp.asarray(paths),
+                          jnp.asarray(lvf, lv_dtype))
+        self._walk_aff_dev = None
+        if self._has_linear:
+            lcf, lft = lin_stacked
+            aff = build_affine_tables(lcf, lft, self.num_features)
+            self._walk_aff_dev = jnp.asarray(aff)
+        self._is_cat_col_dev = jnp.asarray(
+            self._is_cat_feat.astype(np.float32)[:, None])
+        self._walk_interpret = not on_tpu()
+        obs.devprof.transfer(
+            "h2d", "forest",
+            sum(int(a.nbytes) for a in self._walk_dev)
+            + int(self._is_cat_col_dev.nbytes)
+            + (int(self._walk_aff_dev.nbytes)
+               if self._walk_aff_dev is not None else 0),
+            transfers=len(self._walk_dev) + 1
+            + (1 if self._walk_aff_dev is not None else 0))
+        obs.inc("forest_walk_fused_builds")
+        self._walk_binned_jit = CountingJit(self._make_walk_binned_fn(),
+                                            "predict_forest_walk")
+        self._walk_raw_jit = CountingJit(self._make_walk_raw_fn(),
+                                         "serve_forest_walk")
+
+    def _make_walk_binned_fn(self):
+        import jax
+        import jax.numpy as jnp
+        from ..ops.pallas_walk import forest_walk
+
+        nan_bin = int(self._nan_bin)
+        K = self.num_class
+        interp = self._walk_interpret
+
+        if self._has_linear:
+            def walk_lin_fn(walk_dev, aff, bins, mask, xt):
+                fsel, thr, icat, paths, lv = walk_dev
+                raw = forest_walk(fsel, thr, icat, paths, lv, bins,
+                                  num_class=K, nan_bin=nan_bin, aff=aff,
+                                  xt=xt, interpret=interp)
+                return jnp.where(mask[None, :], raw, 0.0)
+            # ledgered by the CountingJit wrapper (predict_forest_walk)
+            return jax.jit(walk_lin_fn)  # graftcheck: disable=jit-raw
+
+        def walk_fn(walk_dev, bins, mask):
+            fsel, thr, icat, paths, lv = walk_dev
+            raw = forest_walk(fsel, thr, icat, paths, lv, bins,
+                              num_class=K, nan_bin=nan_bin,
+                              interpret=interp)
+            return jnp.where(mask[None, :], raw, 0.0)
+        # ledgered by the CountingJit wrapper (predict_forest_walk)
+        return jax.jit(walk_fn)  # graftcheck: disable=jit-raw
+
+    def _make_walk_raw_fn(self):
+        import jax
+        import jax.numpy as jnp
+        from ..ops.pallas_walk import forest_walk_raw
+
+        nan_bin = int(self._nan_bin)
+        max_cuts = int(self.max_cuts)
+        K = self.num_class
+        interp = self._walk_interpret
+
+        def walk_raw_fn(walk_dev, bnd, cats, iscol, X, mask, aff=None):
+            fsel, thr, icat, paths, lv = walk_dev
+            raw = forest_walk_raw(fsel, thr, icat, paths, lv, bnd, cats,
+                                  iscol, X.T, num_class=K,
+                                  nan_bin=nan_bin, max_cuts=max_cuts,
+                                  aff=aff, interpret=interp)
+            raw = jnp.where(mask[None, :], raw, 0.0)
+            out = self._transform(raw)
+            out = jnp.where(mask[None, :], out, 0.0)
+            return raw, out
+        # ledgered by the CountingJit wrapper (serve_forest_walk)
+        return jax.jit(walk_raw_fn)  # graftcheck: disable=jit-raw
 
     # ------------------------------------------------------------------
     # fused programs
@@ -427,6 +609,50 @@ class CompiledForest:
                 f"{self.num_features}")
         return X[:, :self.num_features]
 
+    # ------------------------------------------------------------------
+    # strategy dispatch: these two methods are the ONLY call sites of
+    # the per-strategy jits — every predict entry point routes through
+    # them so fused/gather stay interchangeable everywhere (replicas,
+    # warmup, fleet, hedging).  graftcheck rule serve-strategy-parity
+    # flags any new direct jit call that bypasses them.
+    def _dispatch_binned(self, bucket, bins, mask, xt=None):
+        """Host-binned [K, B] raw scores for one padded bucket."""
+        if self.walk_strategy == "fused":
+            # fused programs take bins in the quantized cut-bin domain:
+            # categorical misses (-1) remap to the nan bin, which routes
+            # identically (neither ever equals a threshold index)
+            bins_q = np.where(bins < 0, self._nan_bin,
+                              bins).astype(self._bin_dtype)
+            if self._has_linear:
+                return self._walk_binned_jit(bucket, self._walk_dev,
+                                             self._walk_aff_dev, bins_q,
+                                             mask, xt)
+            return self._walk_binned_jit(bucket, self._walk_dev, bins_q,
+                                         mask)
+        if self._has_linear:
+            return self._binned_jit(bucket, self._tree_dev, bins, mask,
+                                    self._lin_dev, xt)
+        return self._binned_jit(bucket, self._tree_dev, bins, mask)
+
+    def _dispatch_raw(self, bucket, Xp, mask):
+        """(raw, transformed) for one padded f32 bucket (serving path:
+        on-device binning fused into the program)."""
+        if self.walk_strategy == "fused":
+            if self._has_linear:
+                return self._walk_raw_jit(bucket, self._walk_dev,
+                                          self._bnd_dev, self._cats_dev,
+                                          self._is_cat_col_dev, Xp, mask,
+                                          self._walk_aff_dev)
+            return self._walk_raw_jit(bucket, self._walk_dev,
+                                      self._bnd_dev, self._cats_dev,
+                                      self._is_cat_col_dev, Xp, mask)
+        if self._has_linear:
+            return self._raw_jit(bucket, self._tree_dev, self._bnd_dev,
+                                 self._cats_dev, self._is_cat_dev, Xp,
+                                 mask, self._lin_dev)
+        return self._raw_jit(bucket, self._tree_dev, self._bnd_dev,
+                             self._cats_dev, self._is_cat_dev, Xp, mask)
+
     def raw_scores(self, X) -> np.ndarray:
         """[K, N] f64 raw scores via host-exact binning + the stacked
         walk, bucketed so repeat calls never re-specialize on N."""
@@ -447,11 +673,9 @@ class CompiledForest:
                     xt = np.where(np.isnan(Xp), 0.0,
                                   Xp).T.astype(np.float32)
                     obs.devprof.transfer("h2d", "serve", int(xt.nbytes))
-                    raw = self._binned_jit(bucket, self._tree_dev, bins,
-                                           mask, self._lin_dev, xt)
+                    raw = self._dispatch_binned(bucket, bins, mask, xt)
                 else:
-                    raw = self._binned_jit(bucket, self._tree_dev, bins,
-                                           mask)
+                    raw = self._dispatch_binned(bucket, bins, mask)
             obs.devprof.transfer("d2h", "serve", int(raw.nbytes))
             parts.append(np.asarray(raw, np.float64)[:, :n])
         raw_all = np.concatenate(parts, axis=1)
@@ -474,15 +698,7 @@ class CompiledForest:
             obs.devprof.transfer("h2d", "serve",
                                  int(Xp.nbytes) + int(mask.nbytes))
             with timetag.scope("Predict::forest"):
-                if self._has_linear:
-                    raw, out = self._raw_jit(bucket, self._tree_dev,
-                                             self._bnd_dev, self._cats_dev,
-                                             self._is_cat_dev, Xp, mask,
-                                             self._lin_dev)
-                else:
-                    raw, out = self._raw_jit(bucket, self._tree_dev,
-                                             self._bnd_dev, self._cats_dev,
-                                             self._is_cat_dev, Xp, mask)
+                raw, out = self._dispatch_raw(bucket, Xp, mask)
             obs.devprof.transfer("d2h", "serve",
                                  int(raw.nbytes) + int(out.nbytes))
             raws.append(np.asarray(raw)[:, :n])
@@ -544,6 +760,26 @@ class CompiledForest:
         clone._binned_jit = CountingJit(clone._make_binned_fn(),
                                         "predict_forest")
         clone._raw_jit = CountingJit(clone._make_raw_fn(), "serve_forest")
+        if self.walk_strategy == "fused":
+            clone._walk_dev = tuple(jax.device_put(a, device)
+                                    for a in self._walk_dev)
+            clone._is_cat_col_dev = jax.device_put(self._is_cat_col_dev,
+                                                   device)
+            if self._walk_aff_dev is not None:
+                clone._walk_aff_dev = jax.device_put(self._walk_aff_dev,
+                                                     device)
+            clone._walk_binned_jit = CountingJit(
+                clone._make_walk_binned_fn(), "predict_forest_walk")
+            clone._walk_raw_jit = CountingJit(
+                clone._make_walk_raw_fn(), "serve_forest_walk")
+            obs.devprof.transfer(
+                "h2d", "forest",
+                sum(int(a.nbytes) for a in clone._walk_dev)
+                + int(clone._is_cat_col_dev.nbytes)
+                + (int(clone._walk_aff_dev.nbytes)
+                   if clone._walk_aff_dev is not None else 0),
+                transfers=len(clone._walk_dev) + 1
+                + (1 if clone._walk_aff_dev is not None else 0))
         obs.devprof.transfer(
             "h2d", "forest",
             sum(int(a.nbytes) for a in clone._tree_dev)
@@ -556,12 +792,19 @@ class CompiledForest:
 
     def warmup(self, buckets: Optional[Sequence[int]] = None,
                max_bucket: Optional[int] = None) -> "CompiledForest":
-        """Pre-compile every bucket for both programs so the hot path
-        never hits XLA.  ``max_bucket`` trims the ladder (a server whose
-        ``serve_max_batch`` is 4096 need not compile the 65536 bucket)."""
+        """Pre-compile every bucket BOTH strategy dispatchers can route
+        to, so the hot path never hits XLA.  ``max_bucket`` trims the
+        ladder (a server whose ``serve_max_batch`` is 4096 need not
+        compile the 65536 bucket) — rounded UP to the bucket a
+        ``max_bucket``-row request actually dispatches to: a
+        ``serve_max_batch`` strictly between two ladder rungs routes its
+        largest admitted requests to the rung ABOVE it, which the old
+        ``<= max_bucket`` trim silently left cold (first such request
+        paid a hot-path compile)."""
         sizes = list(buckets) if buckets else list(self.ladder.sizes)
         if max_bucket:
-            kept = [s for s in sizes if s <= max_bucket]
+            cap = self.ladder.bucket_for(int(max_bucket))
+            kept = [s for s in sizes if s <= cap]
             sizes = kept or sizes[:1]
         for s in sizes:
             dummy = np.zeros((min(s, 2), self.num_features))
@@ -569,17 +812,10 @@ class CompiledForest:
             Xp32, mask32 = pad_rows(np.asarray(dummy, np.float32), s)
             if self._has_linear:
                 xt = np.where(np.isnan(Xp), 0.0, Xp).T.astype(np.float32)
-                self._binned_jit(s, self._tree_dev, self.bin_rows(Xp),
-                                 mask, self._lin_dev, xt)
-                self._raw_jit(s, self._tree_dev, self._bnd_dev,
-                              self._cats_dev, self._is_cat_dev, Xp32,
-                              mask32, self._lin_dev)
+                self._dispatch_binned(s, self.bin_rows(Xp), mask, xt)
             else:
-                self._binned_jit(s, self._tree_dev, self.bin_rows(Xp),
-                                 mask)
-                self._raw_jit(s, self._tree_dev, self._bnd_dev,
-                              self._cats_dev, self._is_cat_dev, Xp32,
-                              mask32)
+                self._dispatch_binned(s, self.bin_rows(Xp), mask)
+            self._dispatch_raw(s, Xp32, mask32)
         obs.inc("forest_warmups")
         return self
 
@@ -595,7 +831,12 @@ class CompiledForest:
             "linear": bool(self._has_linear),
             "fingerprint": self.data_fingerprint is not None,
             "drift": self._drift is not None,
+            "serve_walk": self.walk_strategy,
         }
+        if self.walk_strategy == "fused":
+            out["walk_vmem_bytes"] = int(self.walk_vmem_bytes())
+            out["leaf_dtype"] = self.leaf_dtype
+            out["bin_dtype"] = np.dtype(self._bin_dtype).name
         if self.device is not None:
             out["device"] = str(self.device)
         return out

@@ -856,9 +856,19 @@ def serve_from_config(config, params=None) -> PredictServer:
     buckets = list(config.predict_buckets) or default_ladder()
     buckets = [b for b in buckets if b <= max_batch] or [max_batch]
 
+    # walk strategy rides the params dict too, so ModelManager reloads
+    # rebuild the SAME strategy the boot freeze resolved from config
+    walk = str(getattr(config, "serve_walk", "auto") or "auto")
+    quant = bool(getattr(config, "serve_quantize_leaves", False))
+    params = dict(params or {})
+    params.setdefault("serve_walk", walk)
+    params.setdefault("serve_quantize_leaves", quant)
+
     def _freeze(path):
-        booster = Booster(params=dict(params or {}), model_file=path)
-        return CompiledForest.from_booster(booster, buckets=buckets)
+        booster = Booster(params=dict(params), model_file=path)
+        return CompiledForest.from_booster(booster, buckets=buckets,
+                                           serve_walk=walk,
+                                           quantize_leaves=quant)
 
     # crash restore: a state file records the last model that
     # successfully served; a restarted server re-serves THAT, not the

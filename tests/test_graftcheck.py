@@ -795,6 +795,59 @@ def test_timing_suppression_round_trips(tmp_path):
     assert [f.rule for f in report.suppressed] == ["timing-async-dispatch"]
 
 
+# -- family: serve -------------------------------------------------------
+
+def test_serve_strategy_parity_trips(tmp_path):
+    """A strategy jit invoked outside _dispatch_binned/_dispatch_raw
+    hardwires one walk strategy and bypasses the quantized-input remap
+    (docs/SERVING.md §Serving strategies) — seeded bypass must trip."""
+    root = _tree(tmp_path, {"serve/forest.py": """
+        class F:
+            def _dispatch_binned(self, bucket, bins, mask):
+                return self._binned_jit(bucket, bins, mask)   # sanctioned
+
+            def _dispatch_raw(self, bucket, Xp, mask):
+                return self._walk_raw_jit(bucket, Xp, mask)   # sanctioned
+
+            def raw_scores(self, bucket, bins, mask):
+                return self._walk_binned_jit(bucket, bins, mask)
+    """})
+    report = run_checks(root, families=["serve"])
+    assert [f.rule for f in report.findings] == ["serve-strategy-parity"]
+    assert report.findings[0].line == 10
+    assert "_walk_binned_jit" in report.findings[0].message
+
+
+def test_serve_strategy_parity_ignores_non_serve_modules(tmp_path):
+    # construction is fine everywhere; calls outside serve/ are not this
+    # rule's business (no strategy exists there)
+    root = _tree(tmp_path, {
+        "serve/forest.py": """
+            class F:
+                def build(self):
+                    self._binned_jit = make()        # assignment, not call
+        """,
+        "models/gbdt.py": """
+            class G:
+                def run(self, x):
+                    return self._raw_jit(16, x)      # not a serve module
+        """,
+    })
+    report = run_checks(root, families=["serve"])
+    assert report.findings == [], report.findings
+
+
+def test_serve_strategy_parity_suppression_round_trips(tmp_path):
+    root = _tree(tmp_path, {"serve/warm.py": """
+        class W:
+            def warm(self, bucket, bins, mask):
+                return self._binned_jit(bucket, bins, mask)  # graftcheck: disable=serve-strategy-parity
+    """})
+    report = run_checks(root, families=["serve"])
+    assert report.findings == []
+    assert [f.rule for f in report.suppressed] == ["serve-strategy-parity"]
+
+
 # -- the repo itself -----------------------------------------------------
 
 def test_repo_is_clean():

@@ -63,6 +63,17 @@ programs — every pre-r16 baseline — the per-program gate records a note
 and passes: old baselines keep comparing, exactly like the other
 informational blocks, and the ``profile``/``device`` summaries ride
 along per side when present.
+
+``--latency-threshold <pct>`` gates PER-BATCH p99 latency from the
+``latency_sweep`` block (PR 20, the fused Pallas forest-walk kernel):
+``bench.py --mode predict`` times single calls at batch 1/16/64/256 per
+serving strategy and records p50/p99 milliseconds.  For every
+(strategy, batch) point present on both sides the candidate's p99 may
+exceed the baseline's by at most that many percent — the end-to-end
+rows/sec gate averages tail latency away, and tail latency is exactly
+what the fused walk exists to shrink.  When either side lacks the block
+(pre-r20 baselines, --mode train runs) the gate records a note and
+passes, like the per-program gate.
 """
 
 from __future__ import annotations
@@ -111,14 +122,19 @@ def extract_result(path: str) -> Dict[str, Any]:
 def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
             threshold_pct: float,
             warmup_threshold_pct: Optional[float] = None,
-            program_threshold_pct: Optional[float] = None) -> Dict[str, Any]:
+            program_threshold_pct: Optional[float] = None,
+            latency_threshold_pct: Optional[float] = None) -> Dict[str, Any]:
     """Verdict dict; ``ok`` is False when the candidate regressed more
     than ``threshold_pct`` percent below the baseline value, (with a
     warmup threshold) when its warmup exceeds the baseline's by more
-    than ``warmup_threshold_pct`` percent, or (with a program threshold)
+    than ``warmup_threshold_pct`` percent, (with a program threshold)
     when any program's estimated device seconds grew by more than
     ``program_threshold_pct`` percent — skipped with a note when either
-    side carries no profiled programs."""
+    side carries no profiled programs — or (with a latency threshold)
+    when any ``latency_sweep`` p99 grew by more than
+    ``latency_threshold_pct`` percent at any (strategy, batch) point
+    present on both sides — likewise skipped with a note when either
+    side lacks the block."""
     if baseline.get("metric") != candidate.get("metric"):
         raise ValueError(
             f"metric mismatch: baseline {baseline.get('metric')!r} vs "
@@ -205,6 +221,47 @@ def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
         else:
             verdict["programs_ok"] = progs_ok
             verdict["ok"] = verdict["ok"] and progs_ok
+    if latency_threshold_pct is not None:
+        # PR 20: bench.py --mode predict emits a ``latency_sweep`` block
+        # (per serving strategy, per batch size: p50_ms/p99_ms over
+        # single-call dispatches).  The gate is on p99 — tail latency is
+        # what the fused walk kernel exists to shrink, and an end-to-end
+        # rows/sec gate averages it away.  Compared per (strategy, batch)
+        # point present on BOTH sides; one-sided, like every other gate.
+        bl = (baseline.get("latency_sweep") or {}).get("strategies") or {}
+        cl = (candidate.get("latency_sweep") or {}).get("strategies") or {}
+        ldeltas: Dict[str, Any] = {}
+        lat_ok = True
+        for strat in sorted(set(bl) & set(cl)):
+            bpts, cpts = bl[strat] or {}, cl[strat] or {}
+            for batch in sorted(set(bpts) & set(cpts), key=int):
+                b = (bpts[batch] or {}).get("p99_ms")
+                c = (cpts[batch] or {}).get("p99_ms")
+                if b is None or c is None or float(b) <= 0:
+                    continue
+                d = (float(c) - float(b)) / float(b) * 100.0
+                ok = d <= float(latency_threshold_pct)
+                ldeltas[f"{strat}/{batch}"] = {
+                    "baseline_p99_ms": round(float(b), 4),
+                    "candidate_p99_ms": round(float(c), 4),
+                    "delta_pct": round(d, 3), "ok": ok}
+                lat_ok = lat_ok and ok
+        verdict["latency_threshold_pct"] = float(latency_threshold_pct)
+        verdict["latency_delta"] = ldeltas
+        if not bl or not cl:
+            # pre-r20 BENCH files (or --mode train runs) carry no latency
+            # sweep — the gate must not fail them, or every historical
+            # baseline stops comparing; record WHY it passed
+            missing = [s for s, p in (("baseline", bl),
+                                      ("candidate", cl)) if not p]
+            verdict["latency_ok"] = True
+            verdict["latency_note"] = (
+                f"latency_sweep missing on {' and '.join(missing)} — "
+                f"latency gate skipped (run bench.py --mode predict to "
+                f"gate)")
+        else:
+            verdict["latency_ok"] = lat_ok
+            verdict["ok"] = verdict["ok"] and lat_ok
     # informational: the serving-fleet scaling curve (round 8's
     # ``bench.py --mode predict --concurrency N`` adds ``fleet`` /
     # ``concurrency`` keys) rides along in the verdict per side when
@@ -302,12 +359,19 @@ def main(argv=None) -> int:
                          "profile block: allowed INCREASE in percent per "
                          "XLA program (off by default; skipped with a "
                          "note when either side has no profile data)")
+    ap.add_argument("--latency-threshold", type=float, default=None,
+                    help="also gate per-batch p99 latency from the "
+                         "latency_sweep block: allowed INCREASE in "
+                         "percent per (strategy, batch) point (off by "
+                         "default; skipped with a note when either side "
+                         "has no latency sweep)")
     args = ap.parse_args(argv)
     try:
         verdict = compare(extract_result(args.baseline),
                           extract_result(args.candidate), args.threshold,
                           warmup_threshold_pct=args.warmup_threshold,
-                          program_threshold_pct=args.program_threshold)
+                          program_threshold_pct=args.program_threshold,
+                          latency_threshold_pct=args.latency_threshold)
     except (OSError, ValueError, KeyError, json.JSONDecodeError) as exc:
         print(f"bench_regress: {exc}", file=sys.stderr)
         return 2
@@ -327,6 +391,15 @@ def main(argv=None) -> int:
             print(f"bench_regress: PROGRAM REGRESSION {worst[0]} "
                   f"{worst[1]['delta_pct']:+.2f}% device time "
                   f"(threshold +{args.program_threshold:g}%)",
+                  file=sys.stderr)
+        if not verdict.get("latency_ok", True):
+            worst = max(
+                (d for d in verdict.get("latency_delta", {}).items()
+                 if not d[1]["ok"]),
+                key=lambda d: d[1]["delta_pct"])
+            print(f"bench_regress: LATENCY REGRESSION {worst[0]} p99 "
+                  f"{worst[1]['delta_pct']:+.2f}% "
+                  f"(threshold +{args.latency_threshold:g}%)",
                   file=sys.stderr)
         if verdict["delta_pct"] < -args.threshold:
             print(f"bench_regress: REGRESSION {verdict['delta_pct']:+.2f}% "

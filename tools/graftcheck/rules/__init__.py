@@ -22,6 +22,9 @@ metrics    metrics-docs       registry series names documented in
                               docs/OBSERVABILITY.md
 resource   resource-raw-open  write-mode open() routes through
                               utils/diskguard.py (disk-full-safe sinks)
+serve      serve-strategy-    strategy jits called only from the
+           parity             _dispatch_binned/_dispatch_raw choke
+                              points (fused/gather parity)
 timing     timing-async-      no clock deltas around bare jit dispatch
            dispatch           (async dispatch measures enqueue, not
                               execution — sync or route via devprof)
@@ -29,4 +32,4 @@ timing     timing-async-      no clock deltas around bare jit dispatch
 """
 
 from . import (ingress, jit, lifecycle, locks, metrics,  # noqa: F401
-               params, phases, resource, timing, tracer)
+               params, phases, resource, serve, timing, tracer)

@@ -1,0 +1,60 @@
+"""Serving-strategy discipline rules (family ``serve``).
+
+- ``serve-strategy-parity`` — a compiled-forest jit invoked directly
+  (``self._binned_jit(...)``, ``self._raw_jit(...)``,
+  ``self._walk_binned_jit(...)``, ``self._walk_raw_jit(...)``) anywhere
+  in ``lightgbm_tpu/serve/`` outside the two strategy dispatchers
+  (``CompiledForest._dispatch_binned`` / ``_dispatch_raw``).  The
+  fused-walk strategy (PR 20) only keeps its guarantees — gather stays
+  byte-identical in programs and output, fused/gather stay swappable
+  per forest — if strategy selection happens in exactly one place per
+  input kind.  A call site that picks a jit itself silently hardwires
+  one strategy, skips the quantized-input remap, and bypasses the
+  fallback semantics; route it through the dispatcher instead (or waive
+  with an inline suppression so the bypass stays visible and counted).
+  Constructing the CountingJits (``self._binned_jit = CountingJit(...)``)
+  is fine everywhere — only *calls* are strategy decisions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, Project, family
+
+# the per-strategy CountingJit attributes of serve/forest.py; calling
+# one directly IS a strategy decision, so it belongs in a dispatcher
+_STRATEGY_JITS = {"_binned_jit", "_raw_jit",
+                  "_walk_binned_jit", "_walk_raw_jit"}
+
+# the only functions allowed to pick a strategy jit
+_DISPATCHERS = {"_dispatch_binned", "_dispatch_raw"}
+
+
+@family("serve")
+def check_serve(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in project.modules:
+        if "/serve/" not in f"/{m.rel}":
+            continue
+
+        def visit(node, func_name: str):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _STRATEGY_JITS
+                    and func_name not in _DISPATCHERS):
+                findings.append(Finding(
+                    "serve-strategy-parity", m.rel, node.lineno,
+                    f"direct {node.func.attr}(...) call outside the "
+                    f"strategy dispatchers — route through "
+                    f"_dispatch_binned/_dispatch_raw so serve_walk "
+                    f"selection, quantized-input remap and fallback "
+                    f"semantics stay in one place per input kind"))
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func_name = node.name
+            for child in ast.iter_child_nodes(node):
+                visit(child, func_name)
+
+        visit(m.tree, "")
+    return findings
